@@ -1,0 +1,14 @@
+"""Parallelism algorithms and the device-mesh communication layer."""
+
+from distlearn_tpu.parallel.mesh import MeshTree, all_reduce, broadcast_from, node_index
+from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
+from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
+
+__all__ = [
+    "MeshTree",
+    "all_reduce",
+    "broadcast_from",
+    "node_index",
+    "AllReduceSGD",
+    "AllReduceEA",
+]

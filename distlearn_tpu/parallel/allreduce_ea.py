@@ -1,0 +1,210 @@
+"""Synchronous elastic averaging (EASGD) as a single fused collective.
+
+Reference: lua/AllReduceEA.lua + the math note lua/AllReduceEA.md:12-24 —
+EASGD (arXiv:1412.6651) recast so one allreduce per round suffices: every node
+keeps a replica of the center point; every ``tau``-th local step each node
+
+    delta  = (params - center) * alpha
+    params = params - delta                 # elastic pull toward center
+    all_d  = allreduce_sum(delta)
+    center = center + all_d                 # center moves toward the nodes
+
+TPU-native design: center/delta live as a state pytree; the whole round —
+elastic update, psum, center update — is ONE jitted function, so XLA schedules
+the ICI collective overlapped with the elementwise math (the BASELINE.json
+"north star" fused collective).  The ``tau - 1`` intermediate steps are
+communication-free by construction: the host only invokes the fused round when
+a node's local step count hits a ``tau`` boundary, exactly like the reference
+(lua :31).
+
+**Every round is full-participation.**  In the reference, a node at its own
+``tau`` boundary blocks in ``tree.allReduce`` until every other node reaches
+its *own* next allreduce call — so averaging rounds pair up by ordinal, and
+nodes that finished their (uneven) epoch keep serving stragglers' rounds with
+*real* elastic contributions via the inline flush callback (lua :58-68: apply
+center update, compute fresh delta, move, contribute it).  On a gang-scheduled
+mesh this is the natural semantics: whenever any node is due, ALL nodes run the
+elastic round.  This also matters numerically: the inter-node contraction
+factor ``(1 - alpha)`` only applies uniformly under full participation (the
+reference's own EA test passes at 8 nodes, alpha=0.4 — where the center
+recursion factor ``|1 - alpha - N*alpha|`` exceeds 1 — precisely because every
+round contracts the *inter-node* gap even while the center wanders).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distlearn_tpu.parallel import mesh as mesh_lib
+from distlearn_tpu.parallel.mesh import DEFAULT_AXIS, MeshTree
+
+PyTree = Any
+
+
+class EAState(NamedTuple):
+    """Elastic-averaging state carried across steps (functional equivalent of
+    the reference's lazily-cloned ``center``/``delta`` locals, lua :11-22;
+    ``delta`` needs no slot — it is a value, not a buffer, under XLA)."""
+    center: PyTree     # per-node replica of the center point
+    step: jax.Array    # i32 — this node's local step count (ref ``step``, lua :5)
+
+
+def init_state(params: PyTree) -> EAState:
+    """Clone params as the initial center (ref ``oneTimeInit``, lua :11-22)."""
+    return EAState(center=jax.tree_util.tree_map(jnp.array, params),
+                   step=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# In-step pure functions
+# ---------------------------------------------------------------------------
+
+def elastic_round(params: PyTree, state: EAState, alpha: float,
+                  axis_name: str = DEFAULT_AXIS) -> tuple[PyTree, EAState]:
+    """One fused elastic-averaging round (ref lua :35-45 / md :12-24):
+    elastic pull, psum of deltas, center move — a single XLA program."""
+    a = alpha
+
+    delta = jax.tree_util.tree_map(
+        lambda p, c: (p - c) * jnp.asarray(a, p.dtype), params, state.center)
+    new_params = jax.tree_util.tree_map(lambda p, d: p - d, params, delta)
+    sum_delta = jax.tree_util.tree_map(lambda d: lax.psum(d, axis_name), delta)
+    new_center = jax.tree_util.tree_map(lambda c, d: c + d, state.center, sum_delta)
+    return new_params, EAState(center=new_center, step=state.step)
+
+
+def average_parameters(params: PyTree, state: EAState, tau: int, alpha: float,
+                       contrib: jax.Array | None = None,
+                       axis_name: str = DEFAULT_AXIS) -> tuple[PyTree, EAState]:
+    """Per-step entry point (ref ``averageParameters``, lua :25-47).
+
+    Bumps this node's step count; when ANY node's count hits a ``tau``
+    boundary, runs the full-participation fused round (see module docstring).
+    The branch is a ``lax.cond`` so one compiled program serves both cases —
+    but NOTE: for peak throughput call :func:`elastic_round` from the host only
+    on averaging steps and keep the other ``tau - 1`` steps collective-free
+    (what the example trainers do; a skipped psum is not free under cond).
+    """
+    c = jnp.ones((), jnp.int32) if contrib is None else jnp.asarray(contrib, jnp.int32)
+    step = state.step + c
+    my_due = jnp.logical_and(c > 0, (step % tau) == 0)
+    any_due = lax.psum(my_due.astype(jnp.int32), axis_name) > 0
+
+    st = EAState(center=state.center, step=step)
+
+    def _avg(p, s):
+        return elastic_round(p, s, alpha, axis_name=axis_name)
+
+    def _skip(p, s):
+        return p, s
+
+    new_params, new_state = lax.cond(any_due, _avg, _skip, params, st)
+    return new_params, new_state
+
+
+def synchronize_center(params: PyTree, state: EAState,
+                       axis_name: str = DEFAULT_AXIS
+                       ) -> tuple[PyTree, EAState]:
+    """End-of-epoch center sync (ref ``synchronizeCenter``, lua :77-84).
+
+    Straggler rounds have already been served full-participation inside
+    :func:`average_parameters`; what remains of the reference's
+    ``handleUnevenSteps`` is its terminal zero-contribution flush — a no-op —
+    so this reduces to the ``scatter(center)`` drift repair (lua :74-76):
+    broadcast node 0's center replica and reset the step counter.
+    Deterministic XLA psums keep replicas bitwise-identical already, but the
+    broadcast preserves the reference contract under multi-host drift.
+    """
+    center = mesh_lib.broadcast_from(state.center, 0, axis_name)
+    return params, EAState(center=center, step=jnp.zeros((), jnp.int32))
+
+
+def synchronize_parameters(params: PyTree, state: EAState,
+                           axis_name: str = DEFAULT_AXIS
+                           ) -> tuple[PyTree, EAState]:
+    """Force identical params on all nodes (ref lua :87-100): broadcast params
+    from root, reset center := params."""
+    synced = mesh_lib.broadcast_from(params, 0, axis_name)
+    center = jax.tree_util.tree_map(jnp.array, synced)
+    return synced, EAState(center=center, step=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Host-level factory mirroring AllReduceEA(tree, tau, alpha) (lua :2)
+# ---------------------------------------------------------------------------
+
+class AllReduceEA:
+    """Host-level API over stacked node arrays, mirroring the reference
+    closures.  The center lives on device as a stacked node array; per-node
+    step counts are host-side (the host drives round cadence, ref lua :5,31).
+    Every elastic round is one jitted shard_map over the mesh.
+    """
+
+    def __init__(self, tree: MeshTree, tau: int, alpha: float):
+        self.tree = tree
+        self.tau = int(tau)
+        self.alpha = float(alpha)
+        self._axis = tree.axis_name
+        self._center = None     # stacked node array pytree
+        self._steps = None      # host-side per-node counts (ref lua :5)
+        self._round_jit = None
+
+    def _one_time_init(self, params: PyTree):
+        """Ref ``oneTimeInit`` (lua :11-22): clone params as the center."""
+        if self._center is None:
+            self._center = jax.tree_util.tree_map(jnp.array, params)
+            self._steps = np.zeros(self.tree.num_nodes, dtype=np.int64)
+
+    def _round(self, params, center):
+        """Jitted full-participation fused elastic round over stacked arrays."""
+        if self._round_jit is None:
+            axis = self._axis
+
+            def _fn(p, c):
+                p = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), p)
+                c = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), c)
+                st = EAState(center=c, step=jnp.zeros((), jnp.int32))
+                np_, ns = elastic_round(p, st, self.alpha, axis_name=axis)
+                expand = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+                return expand(np_), expand(ns.center)
+
+            self._round_jit = self.tree.spmd(
+                _fn,
+                in_specs=(self.tree.node_spec(),) * 2,
+                out_specs=(self.tree.node_spec(), self.tree.node_spec()))
+        return self._round_jit(params, center)
+
+    def average_parameters(self, params: PyTree, contrib=None) -> PyTree:
+        """Ref lua :25-47: bump local steps; when any node's count hits a tau
+        boundary, run the full-participation elastic round."""
+        self._one_time_init(params)
+        c = np.ones(self.tree.num_nodes, dtype=np.int64) if contrib is None \
+            else np.asarray(contrib, dtype=np.int64)
+        self._steps += c
+        due = (c > 0) & (self._steps % self.tau == 0)
+        if not due.any():
+            return params
+        new_params, self._center = self._round(params, self._center)
+        return new_params
+
+    def synchronize_center(self, params: PyTree) -> PyTree:
+        """Ref lua :77-84: scatter(center) drift repair + step reset (the
+        uneven-step rounds were already served full-participation)."""
+        self._one_time_init(params)
+        self._center = self.tree.scatter(self._center, src=0)
+        self._steps[:] = 0
+        return params
+
+    def synchronize_parameters(self, params: PyTree) -> PyTree:
+        """Ref lua :87-100: scatter(params) + center := params."""
+        if self._steps is None:
+            self._steps = np.zeros(self.tree.num_nodes, dtype=np.int64)
+        params = self.tree.scatter(params, src=0)
+        self._center = jax.tree_util.tree_map(jnp.array, params)
+        self._steps[:] = 0
+        return params

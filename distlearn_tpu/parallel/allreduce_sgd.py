@@ -1,0 +1,161 @@
+"""Synchronous data-parallel gradient averaging (reference: lua/AllReduceSGD.lua).
+
+The reference exposes three closures over a ``tree`` handle:
+
+* ``sumGradients(grads)``            — allreduce-sum gradients (lua :10-15)
+* ``sumAndNormalizeGradients(grads)``— same, then scale by ``1/n`` where ``n``
+  is the number of nodes that contributed this step (lua :18-30; not all nodes
+  contribute every step under uneven data partitioning)
+* ``synchronizeParameters(params)``  — end-of-epoch sync: the node with the
+  most steps wins and its params are broadcast to everyone (lua :33-54)
+
+TPU-native design: per-node state is carried explicitly (functional), nodes are
+mesh devices, and each operation is a pure function usable *inside* a
+``shard_map``-ped step so XLA fuses the psum with the surrounding compute.
+The reference's flush-allreduce dance (lua :37 — nodes that stopped stepping
+contribute zeros to keep the socket tree alive) is unnecessary on a
+gang-scheduled mesh; its *observable* semantics — contributor-count
+normalization and winner-takes-all sync — are reproduced with a participation
+mask (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distlearn_tpu.parallel import mesh as mesh_lib
+from distlearn_tpu.parallel.mesh import DEFAULT_AXIS, MeshTree
+
+PyTree = Any
+
+
+class SGDSyncState(NamedTuple):
+    """Per-node sync state (ref: ``stepsPerNode`` LongTensor, lua :7).
+
+    ``my_steps`` is *this node's* step count this epoch — the reference only
+    ever increments its own slot and allreduces the vector lazily at sync time
+    (lua :13-14, :39), so a per-node scalar carries the same information.
+    """
+    my_steps: jax.Array  # i32 scalar (per-node, sharded)
+
+
+def init_state() -> SGDSyncState:
+    return SGDSyncState(my_steps=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# In-step pure functions (compose inside shard_map-ed train steps)
+# ---------------------------------------------------------------------------
+
+def sum_gradients(grads: PyTree, state: SGDSyncState,
+                  contrib: jax.Array | None = None,
+                  axis_name: str = DEFAULT_AXIS
+                  ) -> tuple[PyTree, SGDSyncState, jax.Array]:
+    """Allreduce-sum gradients across nodes (ref lua :10-15).
+
+    Returns ``(summed_grads, new_state, n_contributors)``.  ``contrib`` is this
+    node's participation flag (defaults to contributing).
+    """
+    c = jnp.ones((), jnp.int32) if contrib is None else jnp.asarray(contrib, jnp.int32)
+    summed, n = mesh_lib.all_reduce(grads, axis_name, contrib=c)
+    new_state = SGDSyncState(my_steps=state.my_steps + c)
+    return summed, new_state, n
+
+
+def sum_and_normalize_gradients(grads: PyTree, state: SGDSyncState,
+                                contrib: jax.Array | None = None,
+                                axis_name: str = DEFAULT_AXIS
+                                ) -> tuple[PyTree, SGDSyncState, jax.Array]:
+    """Allreduce-sum then scale by ``1/n`` contributors (ref lua :18-30)."""
+    summed, new_state, n = sum_gradients(grads, state, contrib, axis_name)
+    scale = jnp.where(n > 0, 1.0 / jnp.maximum(n, 1), 0.0)
+    normed = jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype), summed)
+    return normed, new_state, n
+
+
+def synchronize_parameters(params: PyTree, state: SGDSyncState,
+                           axis_name: str = DEFAULT_AXIS
+                           ) -> tuple[PyTree, SGDSyncState]:
+    """Winner-takes-all end-of-epoch sync (ref lua :33-54).
+
+    Reference semantics: allreduce the per-node step counts, the node with the
+    greatest count wins (ties → highest index, matching ``stepsPerNode:sort()``
+    taking the last element, lua :41), every other node zeros its params, and
+    one final allreduce leaves the winner's params on all nodes — bitwise
+    identical, which is the reference's own test oracle
+    (test/test_AllReduceSGD.lua:38).  Here: all_gather the counts, argmax with
+    last-wins tie-break, masked psum.  The reference's separate
+    ``steps == 0 → plain scatter from root`` branch (lua :52) is the
+    degenerate case where every count is 0 and the winner is the last node;
+    we keep the exact reference behavior by scattering from node 0 when no
+    node stepped.
+    """
+    steps = lax.all_gather(state.my_steps, axis_name)  # [num_nodes]
+    num_nodes = steps.shape[0]
+    # Last-max tie-break: argmax of reversed vector.
+    rev = steps[::-1]
+    winner = num_nodes - 1 - jnp.argmax(rev)
+    # No steps anywhere -> scatter from root (node 0), ref lua :52.
+    winner = jnp.where(jnp.max(steps) > 0, winner, 0)
+    me = lax.axis_index(axis_name)
+    mask = (me == winner)
+    synced = jax.tree_util.tree_map(
+        lambda p: lax.psum(jnp.where(mask, p, jnp.zeros_like(p)), axis_name),
+        params)
+    return synced, SGDSyncState(my_steps=jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Host-level factory mirroring the reference closure API
+# ---------------------------------------------------------------------------
+
+class AllReduceSGD:
+    """Factory over a :class:`MeshTree`, mirroring ``AllReduceSGD(tree)``
+    (lua :4): host-level methods operate on stacked node arrays (leading
+    ``num_nodes`` axis).  Training loops that care about throughput should
+    instead compose the in-step functions above into one jitted train step —
+    see :mod:`distlearn_tpu.train.trainer`.
+    """
+
+    def __init__(self, tree: MeshTree):
+        self.tree = tree
+        self._axis = tree.axis_name
+        # steps per node, host-tracked (ref keeps a LongTensor, lua :7).
+        self._steps = np.zeros(tree.num_nodes, dtype=np.int64)
+
+    def sum_gradients(self, grads: PyTree, contrib=None) -> tuple[PyTree, int]:
+        """Ref lua :10-15. ``grads``: stacked node arrays. Returns (summed, n)."""
+        out, n = self.tree.all_reduce(grads, contrib=contrib)
+        self._bump(contrib)
+        return out, n
+
+    def sum_and_normalize_gradients(self, grads: PyTree, contrib=None
+                                    ) -> tuple[PyTree, int]:
+        """Ref lua :18-30."""
+        out, n = self.tree.all_reduce(grads, contrib=contrib)
+        if n > 1:
+            out = jax.tree_util.tree_map(lambda g: g / n, out)
+        self._bump(contrib)
+        return out, n
+
+    def _bump(self, contrib):
+        if contrib is None:
+            self._steps += 1
+        else:
+            self._steps += np.asarray(contrib, dtype=np.int64)
+
+    def synchronize_parameters(self, params: PyTree) -> PyTree:
+        """Ref lua :33-54: winner-takes-all (most steps, ties → highest index),
+        or plain scatter from root when no node stepped this epoch."""
+        if self._steps.max() > 0:
+            winner = int(len(self._steps) - 1 - np.argmax(self._steps[::-1]))
+            synced = self.tree.scatter(params, src=winner)
+        else:
+            synced = self.tree.scatter(params, src=0)
+        self._steps[:] = 0
+        return synced

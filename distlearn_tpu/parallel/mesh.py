@@ -1,0 +1,239 @@
+"""The device-mesh communication layer: TPU-native replacement for torch-ipc's ``tree``.
+
+The reference framework's entire communication backend is the external torch-ipc
+C++ library: a base-b tree of TCP sockets with ``tree.allReduce`` /
+``tree.scatter`` / ``tree.walkTable`` / ``tree.nodeIndex`` / ``tree.numNodes``
+(reference call sites: lua/AllReduceSGD.lua:12-52, lua/AllReduceEA.lua:41-96,
+examples/mnist.lua:16).  On TPU the idiomatic equivalent is *not* a socket tree:
+"nodes" are devices in a :class:`jax.sharding.Mesh`, per-node values are arrays
+with a leading node axis sharded over that mesh, and every collective lowers to
+an XLA ICI collective (``lax.psum``) inside a jitted function.
+
+Two API levels:
+
+* **In-step collectives** (:func:`all_reduce`, :func:`broadcast_from`,
+  :func:`node_index`): pure functions referencing a mesh axis name, for
+  composing *inside* ``shard_map``-ped train steps — the hot path, where the
+  collective fuses with the surrounding compute in one XLA program.
+
+* **Host-level ops** (:class:`MeshTree`): mirrors the reference ``tree``
+  surface (``all_reduce``, ``scatter``, ``walk``, ``node_index``,
+  ``num_nodes``) operating on *stacked node arrays* — pytrees whose leaves have
+  a leading ``num_nodes`` axis, sharded one-slice-per-device.  Each call is a
+  jitted ``shard_map``.  This is the 1:1 translation surface for porting
+  reference-style scripts; real training loops should prefer the fused
+  builders in :mod:`distlearn_tpu.train`.
+
+``walkTable`` needs no replacement: JAX pytrees + ``jax.tree_util.tree_map``
+are the first-class equivalent; :meth:`MeshTree.walk` is provided for parity.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+DEFAULT_AXIS = "data"
+
+
+# ---------------------------------------------------------------------------
+# In-step collectives (use inside shard_map / pjit-ed step functions)
+# ---------------------------------------------------------------------------
+
+def node_index(axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """This node's 0-based index along the mesh axis (ref: ``tree.nodeIndex``,
+    which is 1-based; here 0-based, matching JAX convention)."""
+    return lax.axis_index(axis_name)
+
+
+def all_reduce(tree: PyTree, axis_name: str = DEFAULT_AXIS,
+               contrib: jax.Array | None = None) -> tuple[PyTree, jax.Array]:
+    """Sum a pytree across the mesh axis; returns ``(reduced_tree, n)``.
+
+    Mirrors ``tree.allReduce(value, add) -> _, n`` (lua/AllReduceSGD.lua:12):
+    ``n`` is the number of *contributing* nodes.  The reference's tree lets
+    non-stepping nodes keep the reduction alive by contributing zeros via a
+    ``zeroFn``; on a gang-scheduled mesh every device always participates, so
+    the same observable semantics are expressed with a participation mask:
+    non-contributors' values are zeroed before the psum and ``n`` counts the
+    mask (SURVEY.md §7 "hard parts").
+
+    Args:
+      tree: pytree of per-node arrays (local shard view, no node axis).
+      axis_name: mesh axis to reduce over.
+      contrib: optional boolean/0-1 scalar — whether *this* node contributes.
+        ``None`` means all nodes contribute.
+    """
+    if contrib is None:
+        n = jnp.asarray(lax.psum(1, axis_name))
+        return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), tree), n
+    c = jnp.asarray(contrib)
+    n = lax.psum(c.astype(jnp.int32), axis_name)
+    masked = jax.tree_util.tree_map(lambda x: x * c.astype(x.dtype), tree)
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_name), masked), n
+
+
+def broadcast_from(tree: PyTree, src, axis_name: str = DEFAULT_AXIS) -> PyTree:
+    """Broadcast ``src``'s values to every node along the axis.
+
+    Replaces ``tree.scatter`` (root broadcast — lua/AllReduceSGD.lua:52,
+    lua/AllReduceEA.lua:83,93): implemented as a psum of masked values, which
+    XLA lowers to an ICI all-reduce (or all-gather+select) — deterministic and
+    bitwise identical on every replica.
+    """
+    idx = lax.axis_index(axis_name)
+    mask = (idx == src)
+
+    def _sel(x):
+        return lax.psum(jnp.where(mask, x, jnp.zeros_like(x)), axis_name)
+
+    return jax.tree_util.tree_map(_sel, tree)
+
+
+def all_gather_scalar(x: jax.Array, axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """Gather a per-node scalar into a ``[num_nodes]`` vector on every node."""
+    return lax.all_gather(x, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Host-level MeshTree
+# ---------------------------------------------------------------------------
+
+class MeshTree:
+    """Host-side handle over a device mesh, mirroring the reference ``tree``.
+
+    Per-node values are **stacked node arrays**: every leaf has a leading
+    ``num_nodes`` axis, sharded one-row-per-device along ``axis_name``.  This
+    is the TPU analogue of "each process holds its own tensor": one global
+    jax.Array whose shards live device-side, collectives run over ICI.
+
+    Construction mirrors ``ipc.LocalhostTree(nodeIndex, numNodes)``
+    (examples/mnist.lua:16) — except a single SPMD program drives all nodes,
+    so there is no per-process handshake; multi-host pods join via
+    ``jax.distributed.initialize`` before constructing the mesh.
+    """
+
+    def __init__(self, num_nodes: int | None = None,
+                 devices: Sequence[jax.Device] | None = None,
+                 axis_name: str = DEFAULT_AXIS):
+        if devices is None:
+            devices = jax.devices()
+        if num_nodes is not None:
+            if num_nodes > len(devices):
+                raise ValueError(
+                    f"num_nodes={num_nodes} exceeds available devices ({len(devices)})")
+            devices = devices[:num_nodes]
+        self.axis_name = axis_name
+        self.mesh = Mesh(np.asarray(devices), (axis_name,))
+        self.num_nodes = len(devices)
+        self._jit_cache: dict = {}
+
+    # -- shardings ---------------------------------------------------------
+    @property
+    def node_sharding(self) -> NamedSharding:
+        """Sharding for stacked node arrays: leading axis split over nodes."""
+        return NamedSharding(self.mesh, P(self.axis_name))
+
+    @property
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def node_spec(self) -> P:
+        return P(self.axis_name)
+
+    # -- data movement -----------------------------------------------------
+    def put_per_node(self, tree: PyTree) -> PyTree:
+        """Place a stacked pytree (leading axis == num_nodes) onto the mesh."""
+        def _put(x):
+            x = jnp.asarray(x)
+            if x.shape[0] != self.num_nodes:
+                raise ValueError(
+                    f"leading axis {x.shape[0]} != num_nodes {self.num_nodes}")
+            return jax.device_put(x, self.node_sharding)
+        return jax.tree_util.tree_map(_put, tree)
+
+    def replicate(self, tree: PyTree) -> PyTree:
+        """Stack one value to all nodes: v -> [num_nodes, *v.shape], sharded."""
+        def _rep(x):
+            x = jnp.asarray(x)
+            stacked = jnp.broadcast_to(x[None], (self.num_nodes,) + x.shape)
+            return jax.device_put(stacked, self.node_sharding)
+        return jax.tree_util.tree_map(_rep, tree)
+
+    # -- collectives on stacked node arrays --------------------------------
+    def _shard_fn(self, key: str, fn: Callable, n_node_args: int,
+                  out_replicated: bool = False):
+        """jit(shard_map(fn)) with per-node in-specs; cached by key."""
+        cache_key = (key, n_node_args, out_replicated)
+        if cache_key not in self._jit_cache:
+            in_specs = tuple(P(self.axis_name) for _ in range(n_node_args))
+            out_specs = P() if out_replicated else P(self.axis_name)
+            mapped = jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False)
+            self._jit_cache[cache_key] = jax.jit(mapped)
+        return self._jit_cache[cache_key]
+
+    def all_reduce(self, tree: PyTree, contrib: jax.Array | None = None
+                   ) -> tuple[PyTree, int]:
+        """Sum per-node values; every node's row ends up holding the sum.
+
+        Mirrors ``tree.allReduce(value, function(a,b) return a:add(b) end)``
+        (lua/AllReduceSGD.lua:12,20): returns ``(reduced, n_contributors)``;
+        the reduced stacked array has identical rows (each node's buffer now
+        holds the reduction, like the in-place torch semantics).
+        """
+        axis = self.axis_name
+
+        if contrib is None:
+            def _ar(t):
+                t = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), t)
+                red, _ = all_reduce(t, axis)
+                return jax.tree_util.tree_map(lambda x: x[None], red)
+            out = self._shard_fn("all_reduce", _ar, 1)(tree)
+            return out, self.num_nodes
+
+        def _arm(t, c):
+            t = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), t)
+            c = jnp.squeeze(c, 0)
+            red, n = all_reduce(t, axis, contrib=c)
+            red = jax.tree_util.tree_map(lambda x: x[None], red)
+            return red, n[None]
+        contrib = jnp.asarray(contrib)
+        out, n = self._shard_fn("all_reduce_masked", _arm, 2)(tree, contrib)
+        return out, int(n[0])
+
+    def scatter(self, tree: PyTree, src: int = 0) -> PyTree:
+        """Broadcast node ``src``'s row to every node (ref: ``tree.scatter``)."""
+        if not 0 <= src < self.num_nodes:
+            raise ValueError(f"src={src} out of range for {self.num_nodes} nodes")
+        axis = self.axis_name
+
+        def _sc(t):
+            t = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), t)
+            out = broadcast_from(t, src, axis)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+        return self._shard_fn(f"scatter_{src}", _sc, 1)(tree)
+
+    def spmd(self, fn: Callable, in_specs, out_specs, static_argnums=()):
+        """shard_map + jit a step function over this mesh (the hot path)."""
+        mapped = jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+        return jax.jit(mapped, static_argnums=static_argnums)
+
+    # -- parity helpers ----------------------------------------------------
+    @staticmethod
+    def walk(tree: PyTree, fn: Callable) -> PyTree:
+        """``tree.walkTable`` parity: map ``fn`` over every leaf."""
+        return jax.tree_util.tree_map(fn, tree)
+
+    def node_slice(self, tree: PyTree, i: int) -> PyTree:
+        """Pull node ``i``'s row back to host (for tests / debugging)."""
+        return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x[i])), tree)

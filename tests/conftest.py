@@ -1,0 +1,35 @@
+"""Test harness: multi-node without a cluster.
+
+The reference tests spawn worker threads with fresh Lua states connected over
+real localhost TCP (``ipc.map`` — test/test_AllReduceSGD.lua:26-35).  The
+TPU-native analogue is a virtual multi-device CPU mesh: force 8 host-platform
+devices so every collective runs through the real shard_map/psum code path
+(SURVEY.md §4 "implication for the TPU build").  Must be set before jax import.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+# Force CPU even when the session env pins a TPU platform (the attached TPU is
+# a single chip; tests need 8 virtual devices).  The env var alone is not
+# enough here: a sitecustomize pre-imports jax at interpreter startup, so the
+# config knob is the reliable override.
+jax.config.update("jax_platforms", "cpu")
+
+# The reference's tensors are torch DoubleTensors by default; the EA invariant
+# test needs float64 to reproduce its <1e-6 oracle (test_AllReduceEA.lua:38).
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs

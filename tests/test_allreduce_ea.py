@@ -1,0 +1,125 @@
+"""AllReduceEA invariants, mirroring test/test_AllReduceEA.lua.
+
+Reference oracle: over 2/4/8 nodes with tau=3, alpha=0.4, each node's params do
+a random walk with geometrically shrinking noise (``params += randn/slowit``,
+``slowit *= 2`` — lua :15-17) for a random 45..53 steps per epoch; after
+``synchronizeCenter`` at each epoch end, the max abs param gap across nodes
+must be < 1e-6 (lua :38-39).  Uneven per-node step counts are expressed with
+participation masks.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
+from distlearn_tpu.parallel.mesh import MeshTree
+
+TAU, ALPHA = 3, 0.4
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_nodes_converge_after_synchronize_center(trial):
+    """Random walk with geometrically shrinking noise; like the reference, the
+    <1e-6 oracle is checked once after all epochs (lua :36-40), in float64
+    (torch's default DoubleTensor)."""
+    rng = np.random.default_rng(trial)
+    num_nodes = int(rng.choice([2, 4, 8]))
+    tree = MeshTree(num_nodes=num_nodes)
+    ea = AllReduceEA(tree, tau=TAU, alpha=ALPHA)
+
+    # Different initial params per node; synchronizeParameters makes them equal
+    # (ref lua :10 does this right after construction).
+    params = [tree.put_per_node(
+        rng.standard_normal((num_nodes, 7)))]
+    params = ea.synchronize_parameters(params)
+
+    slowit = 1.0
+    for _epoch in range(5):
+        steps_per_node = rng.integers(45, 54, size=num_nodes)
+        max_steps = int(steps_per_node.max())
+        for s in range(max_steps):
+            contrib = (s < steps_per_node).astype(np.int64)
+            # random walk with shrinking noise, only on stepping nodes
+            noise = rng.standard_normal((num_nodes, 7)) / slowit
+            noise *= contrib[:, None]
+            params = [params[0] + noise]
+            params = ea.average_parameters(params, contrib=contrib)
+            slowit = min(slowit * 2.0, 2.0 ** 60)
+        params = ea.synchronize_center(params)
+    rows = [tree.node_slice(params, i)[0] for i in range(num_nodes)]
+    for i in range(1, num_nodes):
+        gap = np.abs(rows[0] - rows[i]).max()
+        assert gap < 1e-6, f"nodes should be really close together: {gap}"
+
+
+def test_center_replicas_identical_after_sync():
+    """Center replicas must be bitwise identical after synchronizeCenter
+    (the scatter drift-repair, lua :74-84)."""
+    num_nodes = 4
+    tree = MeshTree(num_nodes=num_nodes)
+    ea = AllReduceEA(tree, tau=2, alpha=0.5)
+    rng = np.random.default_rng(0)
+    params = [tree.put_per_node(rng.standard_normal((num_nodes, 5)).astype(np.float32))]
+    params = ea.synchronize_parameters(params)
+    for s in range(6):
+        params = [params[0] + tree.put_per_node(
+            rng.standard_normal((num_nodes, 5)).astype(np.float32))]
+        params = ea.average_parameters(params)
+    params = ea.synchronize_center(params)
+    centers = [tree.node_slice(ea._center, i)[0] for i in range(num_nodes)]
+    for i in range(1, num_nodes):
+        assert np.array_equal(centers[0], centers[i])
+
+
+def test_tau_gates_communication():
+    """tau-1 of every tau calls must leave params unchanged (comm-free steps,
+    lua :31 — the whole point of EASGD)."""
+    num_nodes = 2
+    tree = MeshTree(num_nodes=num_nodes)
+    ea = AllReduceEA(tree, tau=5, alpha=0.4)
+    params = [tree.replicate(np.ones(3, np.float32))]
+    params = ea.synchronize_parameters(params)
+    before = tree.node_slice(params, 0)[0].copy()
+    for s in range(4):  # steps 1..4: no averaging
+        params = ea.average_parameters(params)
+        np.testing.assert_array_equal(tree.node_slice(params, 0)[0], before)
+    params = ea.average_parameters(params)  # step 5: average fires
+    # params identical across nodes (they started equal) but center moved:
+    # delta = 0 since params == center -> unchanged. Perturb to observe motion.
+    noise = np.stack([np.full(3, i + 1.0, np.float32) for i in range(num_nodes)])
+    params = [params[0] + tree.put_per_node(noise)]
+    for s in range(5):
+        params = ea.average_parameters(params)
+    row0 = tree.node_slice(params, 0)[0]
+    assert not np.array_equal(row0, before + 1.0), "elastic move should have fired"
+
+
+def test_in_step_average_parameters_matches_math():
+    """Fused in-step elastic round reproduces the md :12-24 math exactly."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from distlearn_tpu.parallel import allreduce_ea as ea_lib
+
+    num_nodes = 4
+    tree = MeshTree(num_nodes=num_nodes)
+    axis = tree.axis_name
+    alpha = 0.25
+
+    def step(p, c):
+        p = jnp.squeeze(p, 0)
+        c = jnp.squeeze(c, 0)
+        st = ea_lib.EAState(center=c, step=jnp.zeros((), jnp.int32))
+        new_p, new_st = ea_lib.elastic_round(p, st, alpha, axis_name=axis)
+        return new_p[None], new_st.center[None]
+
+    fn = tree.spmd(step, in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)))
+    rng = np.random.default_rng(7)
+    p = rng.standard_normal((num_nodes, 6)).astype(np.float32)
+    c = np.broadcast_to(rng.standard_normal(6).astype(np.float32), (num_nodes, 6)).copy()
+
+    new_p, new_c = fn(p, c)
+    delta = (p - c) * alpha
+    np.testing.assert_allclose(np.asarray(new_p), p - delta, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(new_c), c + delta.sum(0, keepdims=True), rtol=1e-5)

@@ -1,0 +1,135 @@
+"""AllReduceSGD invariants, mirroring test/test_AllReduceSGD.lua.
+
+Reference oracle: randomized trials over 2/4/8 nodes where each node performs a
+random (uneven) number of steps per epoch — 4..13 (lua :13) — of
+fill-random-grads / sumAndNormalizeGradients / SGD update, then
+``synchronizeParameters``; afterwards params must be **bitwise identical** on
+every node (lua :38).  Uneven per-node step counts are expressed with
+participation masks (the gang-scheduled-mesh equivalent of the reference's
+flush allreduce — SURVEY.md §7).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distlearn_tpu.parallel import allreduce_sgd as ars
+from distlearn_tpu.parallel.mesh import MeshTree
+
+
+def _param_like(rng, num_nodes, shapes):
+    """Identical initial params on every node (ref: torch.manualSeed(0))."""
+    base = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+    return [np.broadcast_to(b[None], (num_nodes,) + b.shape).copy() for b in base]
+
+
+SHAPES = [(5, 3), (7,), (2, 4, 3)]
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_params_bitwise_equal_after_sync_host_api(trial):
+    rng = np.random.default_rng(trial)
+    num_nodes = int(rng.choice([2, 4, 8]))
+    tree = MeshTree(num_nodes=num_nodes)
+    sgd = ars.AllReduceSGD(tree)
+
+    params = tree.put_per_node(_param_like(rng, num_nodes, SHAPES))
+    lr = 0.01
+
+    for _epoch in range(3):
+        steps_per_node = rng.integers(4, 14, size=num_nodes)
+        max_steps = int(steps_per_node.max())
+        for s in range(max_steps):
+            contrib = (s < steps_per_node).astype(np.int32)
+            # Each contributing node produces its own random gradient.
+            grads = [rng.standard_normal((num_nodes,) + sh).astype(np.float32)
+                     for sh in SHAPES]
+            grads = tree.put_per_node(grads)
+            summed, n = sgd.sum_and_normalize_gradients(grads, contrib=contrib)
+            assert n == int(contrib.sum())
+            # SGD update only on contributing nodes (a node that didn't step
+            # leaves its params untouched, as in the reference).
+            params = [
+                p - lr * g * jnp.asarray(contrib, jnp.float32).reshape(
+                    (num_nodes,) + (1,) * (p.ndim - 1))
+                for p, g in zip(params, summed)
+            ]
+        params = sgd.synchronize_parameters(params)
+        rows = [tree.node_slice(params, i) for i in range(num_nodes)]
+        for i in range(1, num_nodes):
+            for a, b in zip(rows[0], rows[i]):
+                assert np.array_equal(a, b), "params differ bitwise after sync"
+
+
+def test_winner_takes_all_semantics():
+    """The node with the most steps provides the synced params (lua :41-47);
+    ties go to the highest node index (sort-ascending, take last)."""
+    num_nodes = 4
+    tree = MeshTree(num_nodes=num_nodes)
+    sgd = ars.AllReduceSGD(tree)
+    params = tree.put_per_node(
+        np.arange(num_nodes * 2, dtype=np.float32).reshape(num_nodes, 2))
+
+    # node 2 steps twice, node 1 steps once, others none
+    for contrib in ([0, 1, 1, 0], [0, 0, 1, 0]):
+        grads = tree.put_per_node(np.zeros((num_nodes, 2), np.float32))
+        sgd.sum_and_normalize_gradients(grads, contrib=np.array(contrib, np.int32))
+    synced = sgd.synchronize_parameters(params)
+    for i in range(num_nodes):
+        np.testing.assert_array_equal(
+            tree.node_slice(synced, i), np.array([4.0, 5.0]))  # node 2's row
+
+
+def test_no_steps_scatters_from_root():
+    """With zero steps this epoch, sync degenerates to scatter from node 0 (lua :52)."""
+    num_nodes = 4
+    tree = MeshTree(num_nodes=num_nodes)
+    sgd = ars.AllReduceSGD(tree)
+    params = tree.put_per_node(
+        np.arange(num_nodes * 2, dtype=np.float32).reshape(num_nodes, 2))
+    synced = sgd.synchronize_parameters(params)
+    for i in range(num_nodes):
+        np.testing.assert_array_equal(
+            tree.node_slice(synced, i), np.array([0.0, 1.0]))
+
+
+@pytest.mark.parametrize("trial", range(3))
+def test_in_step_api_inside_one_jitted_step(trial):
+    """The hot path: grads psum + normalize + update fused in ONE shard_map'd
+    jitted step; params stay replicated and bitwise identical by construction."""
+    rng = np.random.default_rng(100 + trial)
+    num_nodes = 8
+    tree = MeshTree(num_nodes=num_nodes)
+    axis = tree.axis_name
+
+    def step(params, grads, state, contrib):
+        grads = jnp.squeeze(grads, 0)
+        contrib = jnp.squeeze(contrib, 0)
+        state = ars.SGDSyncState(my_steps=jnp.squeeze(state.my_steps, 0))
+        g, st, n = ars.sum_and_normalize_gradients(grads, state, contrib, axis)
+        # Replicated-params DP: the psum'd gradient is identical on every node,
+        # so all nodes (contributing or not) apply the same update and params
+        # never drift — the TPU-first design that makes winner-takes-all sync
+        # a no-op in the fused trainer.
+        new_p = params - 0.1 * g
+        return new_p, g[None], ars.SGDSyncState(my_steps=st.my_steps[None]), n[None]
+
+    fn = tree.spmd(step,
+                   in_specs=(P(), P(axis), ars.SGDSyncState(my_steps=P(axis)), P(axis)),
+                   out_specs=(P(), P(axis), ars.SGDSyncState(my_steps=P(axis)), P(axis)))
+
+    params = jnp.asarray(rng.standard_normal(6).astype(np.float32))
+    grads = rng.standard_normal((num_nodes, 6)).astype(np.float32)
+    contrib = np.array([1, 1, 0, 1, 0, 1, 1, 1], np.float32)
+    state = ars.SGDSyncState(my_steps=np.zeros(num_nodes, np.int32))
+
+    new_p, g, state, n = fn(params, grads, state, contrib)
+    expected_g = (grads * contrib[:, None]).sum(0) / contrib.sum()
+    np.testing.assert_allclose(np.asarray(g)[0], expected_g, rtol=1e-6)
+    assert np.asarray(n)[0] == 6
+    np.testing.assert_array_equal(np.asarray(state.my_steps), contrib.astype(np.int32))
+    # masked nodes left params untouched... params are replicated: updated once
+    np.testing.assert_allclose(
+        np.asarray(new_p), np.asarray(params) - 0.1 * expected_g, rtol=1e-6)

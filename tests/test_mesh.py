@@ -1,0 +1,67 @@
+"""MeshTree collective semantics (the torch-ipc ``tree`` contract, SURVEY §1 L1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distlearn_tpu.parallel.mesh import MeshTree
+
+
+@pytest.mark.parametrize("num_nodes", [2, 4, 8])
+def test_all_reduce_sums_across_nodes(num_nodes):
+    tree = MeshTree(num_nodes=num_nodes)
+    vals = tree.put_per_node(
+        {"w": np.arange(num_nodes * 3, dtype=np.float32).reshape(num_nodes, 3)})
+    reduced, n = tree.all_reduce(vals)
+    assert n == num_nodes
+    expected = np.arange(num_nodes * 3, dtype=np.float32).reshape(num_nodes, 3).sum(0)
+    for i in range(num_nodes):
+        np.testing.assert_array_equal(tree.node_slice(reduced, i)["w"], expected)
+
+
+def test_all_reduce_contrib_mask_counts_contributors():
+    num_nodes = 4
+    tree = MeshTree(num_nodes=num_nodes)
+    vals = tree.put_per_node(np.ones((num_nodes, 2), np.float32))
+    contrib = np.array([1, 0, 1, 0], np.int32)
+    reduced, n = tree.all_reduce(vals, contrib=contrib)
+    assert n == 2
+    for i in range(num_nodes):
+        np.testing.assert_array_equal(tree.node_slice(reduced, i), np.full(2, 2.0, np.float32))
+
+
+@pytest.mark.parametrize("src", [0, 2])
+def test_scatter_broadcasts_src_row(src):
+    num_nodes = 4
+    tree = MeshTree(num_nodes=num_nodes)
+    data = np.stack([np.full(3, i, np.float32) for i in range(num_nodes)])
+    out = tree.scatter(tree.put_per_node(data), src=src)
+    for i in range(num_nodes):
+        np.testing.assert_array_equal(tree.node_slice(out, i), np.full(3, src, np.float32))
+
+
+def test_replicate_and_pytree_walk():
+    tree = MeshTree(num_nodes=4)
+    params = {"a": np.ones(3, np.float32), "b": {"c": np.zeros((2, 2), np.float32)}}
+    rep = tree.replicate(params)
+    assert rep["a"].shape == (4, 3)
+    walked = tree.walk(rep, lambda x: x + 1)
+    np.testing.assert_array_equal(tree.node_slice(walked, 2)["b"]["c"], np.ones((2, 2)))
+
+
+def test_spmd_step_with_in_step_collectives():
+    """Composing in-step all_reduce inside a shard_map'd fn over the mesh."""
+    from distlearn_tpu.parallel import mesh as m
+    tree = MeshTree(num_nodes=8)
+    from jax.sharding import PartitionSpec as P
+
+    def step(x):
+        x = jnp.squeeze(x, 0)
+        red, n = m.all_reduce(x, tree.axis_name)
+        return (red / n)[None]
+
+    fn = tree.spmd(step, in_specs=(P(tree.axis_name),), out_specs=P(tree.axis_name))
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = fn(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), x.mean()), rtol=1e-6)
