@@ -1,0 +1,49 @@
+"""Host→device batch streaming with async prefetch.
+
+The reference's torch-dataset runs a native thread pool that stages batches
+(and can land them directly on GPU via the ``cuda`` batcher flag,
+examples/Data.lua:27).  TPU-native equivalent: ``jax.device_put`` is async —
+it returns immediately with the transfer in flight — so a depth-k prefetch
+queue overlaps host batch assembly + PCIe/infeed with device compute.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+
+def batch_iterator(dataset, sampler, batch_size: int,
+                   processor: Callable | None = None) -> Iterator[tuple]:
+    """Yield (x, y) numpy batches for one epoch (gather + optional processor —
+    the reference's sampledBatcher processor fn, examples/cifar10.lua:58-66)."""
+    for idx in sampler.epoch(batch_size):
+        x, y = dataset.x[idx], dataset.y[idx]
+        if processor is not None:
+            x, y = processor(x, y)
+        yield x, y
+
+
+def prefetch_to_device(it: Iterator, size: int = 2, sharding=None) -> Iterator:
+    """Wrap a host batch iterator with a depth-``size`` device prefetch queue.
+
+    ``sharding``: optional jax sharding applied on transfer (e.g. batch axis
+    split over the data mesh axis so each device receives only its shard).
+    """
+    queue = collections.deque()
+
+    def _put(batch):
+        if sharding is None:
+            return jax.tree_util.tree_map(jax.device_put, batch)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), batch)
+
+    for batch in it:
+        queue.append(_put(batch))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -1,0 +1,9 @@
+"""Functional model zoo (the reference ships MNIST CNN + CIFAR convnet as
+training-script-local model defs — examples/mnist.lua:53-81,
+examples/Model.lua; here they are a first-class module)."""
+
+from distlearn_tpu.models.core import Model, loss_fn, param_count
+from distlearn_tpu.models.mnist_cnn import mnist_cnn
+from distlearn_tpu.models.cifar_convnet import cifar_convnet
+
+__all__ = ["Model", "loss_fn", "param_count", "mnist_cnn", "cifar_convnet"]

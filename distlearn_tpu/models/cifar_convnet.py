@@ -1,0 +1,56 @@
+"""CIFAR-10 convnet — TPU-native rebuild of the reference 5-block VGG-ish net
+(examples/Model.lua:19-45 == examples/cifar10.lua:100-163):
+
+    4 x [ conv5x5 pad2 (3->64->128->256->512) -> batchnorm(eps=1e-3) -> ReLU
+          -> maxpool2x2 ]
+    -> flatten(512*2*2) -> dropout(0.5) -> linear(2048->10) -> logSoftMax
+
+NHWC: 32 -> 16 -> 8 -> 4 -> 2.  Batch-norm running stats live in the state
+pytree; pass ``axis_name`` for cross-replica (sync) statistics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import random
+
+from distlearn_tpu.models import nn
+from distlearn_tpu.models.core import Model
+
+_CHANNELS = (64, 128, 256, 512)
+
+
+def cifar_convnet(dtype=jnp.float32, compute_dtype=None,
+                  dropout_rate: float = 0.5) -> Model:
+    def init(key):
+        keys = random.split(key, len(_CHANNELS) + 1)
+        params, state = {}, {}
+        in_ch = 3
+        for i, ch in enumerate(_CHANNELS):
+            bn_p, bn_s = nn.batchnorm_init(ch, dtype)
+            params[f"conv{i + 1}"] = nn.conv2d_init(keys[i], in_ch, ch, 5, 5, dtype)
+            params[f"bn{i + 1}"] = bn_p
+            state[f"bn{i + 1}"] = bn_s
+            in_ch = ch
+        params["linear"] = nn.dense_init(keys[-1], 512 * 2 * 2, 10, dtype)
+        return params, state
+
+    def apply(params, state, x, train=True, rng=None, axis_name=None,
+              bn_weight=None):
+        h = x
+        new_state = {}
+        for i in range(1, len(_CHANNELS) + 1):
+            h = nn.conv2d(params[f"conv{i}"], h, padding=((2, 2), (2, 2)),
+                          compute_dtype=compute_dtype)
+            h, new_state[f"bn{i}"] = nn.batchnorm(
+                params[f"bn{i}"], state[f"bn{i}"], h, train=train,
+                eps=1e-3, axis_name=axis_name, weight=bn_weight)
+            h = nn.max_pool2d(jnp.maximum(h, 0))
+        h = h.reshape(h.shape[0], -1)
+        if train and rng is not None and dropout_rate > 0:
+            h = nn.dropout(rng, h, dropout_rate, train=True)
+        logits = nn.dense(params["linear"], h, compute_dtype=compute_dtype)
+        return nn.log_softmax(logits.astype(dtype)), new_state
+
+    return Model(init=init, apply=apply, name="cifar_convnet",
+                 input_shape=(32, 32, 3), num_classes=10)

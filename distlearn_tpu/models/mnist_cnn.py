@@ -1,0 +1,42 @@
+"""MNIST CNN — TPU-native rebuild of the reference architecture
+(examples/mnist.lua:53-81):
+
+    reshape(1,32,32) -> conv5x5(1->16) -> tanh -> maxpool2x2
+                     -> conv5x5(16->16) -> tanh -> maxpool2x2
+                     -> flatten(400) -> linear(400->10) -> logSoftMax
+
+Here in NHWC: [N,32,32,1] -> 28 -> 14 -> 10 -> 5 -> flatten 400 -> 10.
+No batchnorm, so the mutable state pytree is empty.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import random
+
+from distlearn_tpu.models import nn
+from distlearn_tpu.models.core import Model
+
+
+def mnist_cnn(dtype=jnp.float32, compute_dtype=None) -> Model:
+    def init(key):
+        k1, k2, k3 = random.split(key, 3)
+        params = {
+            "conv1": nn.conv2d_init(k1, 1, 16, 5, 5, dtype),
+            "conv2": nn.conv2d_init(k2, 16, 16, 5, 5, dtype),
+            "linear": nn.dense_init(k3, 16 * 5 * 5, 10, dtype),
+        }
+        return params, {}
+
+    def apply(params, state, x, train=True, rng=None, axis_name=None,
+              bn_weight=None):
+        h = nn.conv2d(params["conv1"], x, compute_dtype=compute_dtype)
+        h = nn.max_pool2d(jnp.tanh(h))
+        h = nn.conv2d(params["conv2"], h, compute_dtype=compute_dtype)
+        h = nn.max_pool2d(jnp.tanh(h))
+        h = h.reshape(h.shape[0], -1)
+        logits = nn.dense(params["linear"], h, compute_dtype=compute_dtype)
+        return nn.log_softmax(logits.astype(dtype)), state
+
+    return Model(init=init, apply=apply, name="mnist_cnn",
+                 input_shape=(32, 32, 1), num_classes=10)

@@ -1,0 +1,180 @@
+"""Minimal functional NN layer library — the TPU-native stand-in for the
+reference's ``grad.nn.*`` primitives (torch-autograd wrapping torch7 nn;
+reference call sites: examples/mnist.lua:53-67, examples/Model.lua:19-45).
+
+Design notes (TPU-first):
+
+* **NHWC layout**: XLA's TPU conv emitter prefers NHWC activations with HWIO
+  kernels — feature dim last lands on the 128-wide lane axis of the MXU/VPU.
+  (The reference uses torch NCHW; layout is an implementation detail the
+  framework owns, not API surface.)
+* **Functional**: every layer is ``init(key, ...) -> params`` plus a pure
+  ``apply``.  Mutable state (batch-norm running stats) is an explicit pytree
+  threaded through apply, never hidden module state — this is what lets the
+  whole train step jit into one XLA program.
+* **dtype policy**: params are stored f32 (or f64 under x64 tests); compute
+  dtype is a caller choice — pass ``compute_dtype=jnp.bfloat16`` to run the
+  matmuls/convs on the MXU in bf16 with f32 params (master weights).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# Initializers (match torch7 defaults: U(-1/sqrt(fanin), 1/sqrt(fanin)),
+# which is what the reference's grad.nn layers use via nn.Linear/
+# SpatialConvolutionMM reset())
+# ---------------------------------------------------------------------------
+
+def _uniform_fanin(key, shape, fan_in, dtype):
+    bound = 1.0 / math.sqrt(fan_in)
+    return random.uniform(key, shape, dtype, -bound, bound)
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+
+def dense_init(key, in_features: int, out_features: int, dtype=jnp.float32):
+    kw, kb = random.split(key)
+    return {
+        "w": _uniform_fanin(kw, (in_features, out_features), in_features, dtype),
+        "b": _uniform_fanin(kb, (out_features,), in_features, dtype),
+    }
+
+
+def dense(params, x, compute_dtype=None):
+    w, b = params["w"], params["b"]
+    if compute_dtype is not None:
+        x, w = x.astype(compute_dtype), w.astype(compute_dtype)
+    y = x @ w
+    return y + b.astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Conv2D (NHWC x HWIO -> NHWC)
+# ---------------------------------------------------------------------------
+
+def conv2d_init(key, in_ch: int, out_ch: int, kh: int, kw: int, dtype=jnp.float32):
+    kk, kb = random.split(key)
+    fan_in = in_ch * kh * kw
+    return {
+        "w": _uniform_fanin(kk, (kh, kw, in_ch, out_ch), fan_in, dtype),
+        "b": _uniform_fanin(kb, (out_ch,), fan_in, dtype),
+    }
+
+
+def conv2d(params, x, stride=(1, 1), padding="VALID", compute_dtype=None):
+    """x: [N,H,W,C]; kernel HWIO.  Padding: 'VALID' | 'SAME' | ((ph,ph),(pw,pw))."""
+    w, b = params["w"], params["b"]
+    if compute_dtype is not None:
+        x, w = x.astype(compute_dtype), w.astype(compute_dtype)
+    y = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + b.astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+def max_pool2d(x, window=(2, 2), stride=(2, 2)):
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, window[0], window[1], 1),
+        window_strides=(1, stride[0], stride[1], 1),
+        padding="VALID")
+
+
+def avg_pool2d(x, window=(2, 2), stride=(2, 2)):
+    s = lax.reduce_window(
+        x, jnp.zeros((), x.dtype), lax.add,
+        window_dimensions=(1, window[0], window[1], 1),
+        window_strides=(1, stride[0], stride[1], 1),
+        padding="VALID")
+    return s / (window[0] * window[1])
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (SpatialBatchNormalization parity — examples/Model.lua:20 et al.)
+# ---------------------------------------------------------------------------
+
+def batchnorm_init(ch: int, dtype=jnp.float32):
+    params = {"scale": jnp.ones((ch,), dtype), "bias": jnp.zeros((ch,), dtype)}
+    stats = {"mean": jnp.zeros((ch,), dtype), "var": jnp.ones((ch,), dtype)}
+    return params, stats
+
+
+def batchnorm(params, stats, x, train: bool, eps=1e-3, momentum=0.1,
+              axis_name: str | None = None, weight=None):
+    """Channel-last batchnorm over (N,H,W) or (N,).
+
+    ``axis_name``: when set, batch statistics are psum'd across that mesh axis
+    so every data-parallel replica normalizes with *global* batch stats (sync
+    BN) — the TPU-native upgrade over per-replica stats; pass ``None`` for
+    per-node stats (the reference's behavior, each process normalizes its own
+    shard).  ``weight``: optional per-node scalar 0/1 participation weight —
+    non-contributing nodes (uneven data partitions) are excluded from the
+    cross-node statistics, mirroring how they are excluded from the gradient
+    sum (lua/AllReduceSGD.lua:22-27).  Returns (y, new_stats).
+    """
+    reduce_axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=reduce_axes)
+        mean2 = jnp.mean(jnp.square(x), axis=reduce_axes)
+        if axis_name is not None:
+            if weight is None:
+                mean = lax.pmean(mean, axis_name)
+                mean2 = lax.pmean(mean2, axis_name)
+            else:
+                w = jnp.asarray(weight, mean.dtype)
+                denom = jnp.maximum(lax.psum(w, axis_name), 1)
+                mean = lax.psum(mean * w, axis_name) / denom
+                mean2 = lax.psum(mean2 * w, axis_name) / denom
+        var = mean2 - jnp.square(mean)
+        m = jnp.asarray(momentum, stats["mean"].dtype)
+        new_stats = {
+            "mean": (1 - m) * stats["mean"] + m * mean.astype(stats["mean"].dtype),
+            "var": (1 - m) * stats["var"] + m * var.astype(stats["var"].dtype),
+        }
+    else:
+        mean, var = stats["mean"].astype(x.dtype), stats["var"].astype(x.dtype)
+        new_stats = stats
+    inv = lax.rsqrt(var.astype(x.dtype) + jnp.asarray(eps, x.dtype))
+    y = (x - mean.astype(x.dtype)) * inv
+    y = y * params["scale"].astype(x.dtype) + params["bias"].astype(x.dtype)
+    return y, new_stats
+
+
+# ---------------------------------------------------------------------------
+# Activations / heads
+# ---------------------------------------------------------------------------
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def nll_loss(log_probs, labels):
+    """ClassNLLCriterion parity (examples/Model.lua:52): mean over batch of
+    -log p[label].  ``labels``: int [N]."""
+    ll = jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    return -jnp.mean(ll)
+
+
+def dropout(key, x, rate: float, train: bool):
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

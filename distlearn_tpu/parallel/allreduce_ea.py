@@ -166,12 +166,11 @@ class AllReduceEA:
             axis = self._axis
 
             def _fn(p, c):
-                p = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), p)
-                c = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), c)
-                st = EAState(center=c, step=jnp.zeros((), jnp.int32))
-                np_, ns = elastic_round(p, st, self.alpha, axis_name=axis)
-                expand = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-                return expand(np_), expand(ns.center)
+                st = EAState(center=mesh_lib.squeeze_node(c),
+                             step=jnp.zeros((), jnp.int32))
+                np_, ns = elastic_round(mesh_lib.squeeze_node(p), st,
+                                        self.alpha, axis_name=axis)
+                return mesh_lib.expand_node(np_), mesh_lib.expand_node(ns.center)
 
             self._round_jit = self.tree.spmd(
                 _fn,

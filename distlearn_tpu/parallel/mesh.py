@@ -103,6 +103,17 @@ def all_gather_scalar(x: jax.Array, axis_name: str = DEFAULT_AXIS) -> jax.Array:
     return lax.all_gather(x, axis_name)
 
 
+def squeeze_node(tree: PyTree) -> PyTree:
+    """Drop the local size-1 node axis inside a shard_map over stacked node
+    arrays (each device sees its [1, ...] slice of the stack)."""
+    return jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), tree)
+
+
+def expand_node(tree: PyTree) -> PyTree:
+    """Re-add the local node axis before returning from a shard_map."""
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
 # ---------------------------------------------------------------------------
 # Host-level MeshTree
 # ---------------------------------------------------------------------------
@@ -194,18 +205,15 @@ class MeshTree:
 
         if contrib is None:
             def _ar(t):
-                t = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), t)
-                red, _ = all_reduce(t, axis)
-                return jax.tree_util.tree_map(lambda x: x[None], red)
+                red, _ = all_reduce(squeeze_node(t), axis)
+                return expand_node(red)
             out = self._shard_fn("all_reduce", _ar, 1)(tree)
             return out, self.num_nodes
 
         def _arm(t, c):
-            t = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), t)
             c = jnp.squeeze(c, 0)
-            red, n = all_reduce(t, axis, contrib=c)
-            red = jax.tree_util.tree_map(lambda x: x[None], red)
-            return red, n[None]
+            red, n = all_reduce(squeeze_node(t), axis, contrib=c)
+            return expand_node(red), n[None]
         contrib = jnp.asarray(contrib)
         out, n = self._shard_fn("all_reduce_masked", _arm, 2)(tree, contrib)
         return out, int(n[0])
@@ -217,9 +225,8 @@ class MeshTree:
         axis = self.axis_name
 
         def _sc(t):
-            t = jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), t)
-            out = broadcast_from(t, src, axis)
-            return jax.tree_util.tree_map(lambda x: x[None], out)
+            out = broadcast_from(squeeze_node(t), src, axis)
+            return expand_node(out)
         return self._shard_fn(f"scatter_{src}", _sc, 1)(tree)
 
     def spmd(self, fn: Callable, in_specs, out_specs, static_argnums=()):

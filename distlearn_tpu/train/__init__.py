@@ -1,0 +1,14 @@
+"""Fused train-step builders — the TPU hot path (SURVEY.md §3.1's hot loop
+collapsed into single XLA programs)."""
+
+from distlearn_tpu.train.trainer import (TrainState, EATrainState,
+                                         init_train_state, init_ea_state,
+                                         build_sgd_step, build_sync_step,
+                                         build_eval_step, build_ea_steps,
+                                         reduce_confusion)
+
+__all__ = [
+    "TrainState", "EATrainState", "init_train_state", "init_ea_state",
+    "build_sgd_step", "build_sync_step", "build_eval_step", "build_ea_steps",
+    "reduce_confusion",
+]

@@ -1,0 +1,275 @@
+"""Fused train-step builders — the TPU hot path.
+
+The reference's hot loop is: dataset batch → autograd fwd+bwd →
+``tree.allReduce`` over TCP → manual SGD update (call stack SURVEY.md §3.1,
+examples/mnist.lua:99-116).  Every stage is a separate host-driven operation
+crossing the process boundary.  The TPU-native design collapses the entire
+step — forward, backward, gradient psum, normalization, SGD update, metric
+update — into ONE jitted ``shard_map`` program per mesh, so XLA overlaps the
+ICI collective with backprop compute and fuses the elementwise update into the
+gradient producers.  This is the BASELINE.json north-star structure.
+
+Two families:
+
+* :func:`build_sgd_step` — AllReduceSGD training.  Params REPLICATED across
+  the mesh (spec ``P()``), batch sharded along the data axis.  Gradients are
+  psum'd and contributor-normalized (lua/AllReduceSGD.lua:18-30 semantics)
+  inside the step.
+
+* :func:`build_ea_steps` — AllReduceEA training.  Params are PER-NODE (stacked
+  leading node axis, spec ``P(axis)``) because EASGD nodes intentionally
+  diverge between averaging rounds.  Returns a collective-free local step and
+  a fused elastic-round step; the host calls the round every ``tau`` steps
+  (τ−1 of τ steps run with zero communication — the point of EASGD,
+  lua/AllReduceEA.lua:31).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+from jax.sharding import PartitionSpec as P
+
+from distlearn_tpu.models.core import Model, loss_fn
+from distlearn_tpu.parallel import allreduce_ea, allreduce_sgd
+from distlearn_tpu.parallel import mesh as mesh_lib
+from distlearn_tpu.parallel.mesh import MeshTree
+from distlearn_tpu.utils import metrics as metrics_lib
+
+PyTree = Any
+
+
+class TrainState(NamedTuple):
+    """Carried through the jitted SGD step (all donated).
+
+    ``cm`` is a stacked per-node confusion matrix ``[num_nodes, C, C]``
+    sharded over the data axis (each node counts its own shard's
+    predictions; sum at report time — ref examples/mnist.lua:120-125).
+    """
+    params: PyTree
+    model_state: PyTree      # batchnorm running stats (sync-BN: replicated)
+    sync: allreduce_sgd.SGDSyncState   # my_steps stacked [num_nodes], sharded
+    cm: jax.Array            # [num_nodes, C, C] device-side confusion matrix
+    rng: jax.Array
+
+
+def _sgd_update(params: PyTree, grads: PyTree, lr) -> PyTree:
+    """Manual SGD — the reference's update loop (examples/mnist.lua:112-116)."""
+    return jax.tree_util.tree_map(
+        lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+        params, grads)
+
+
+def init_train_state(model: Model, tree: MeshTree, key: jax.Array,
+                     num_classes: int) -> TrainState:
+    init_key, train_key = random.split(key)
+    params, mstate = model.init(init_key)
+    n = tree.num_nodes
+    return TrainState(
+        params=params, model_state=mstate,
+        sync=allreduce_sgd.SGDSyncState(
+            my_steps=tree.put_per_node(jnp.zeros((n,), jnp.int32))),
+        cm=tree.put_per_node(jnp.zeros((n, num_classes, num_classes),
+                                       jnp.int32)),
+        rng=train_key)
+
+
+def build_sgd_step(model: Model, tree: MeshTree, lr: float,
+                   donate: bool = True, with_contrib: bool = False) -> Callable:
+    """One fused AllReduceSGD step: ``step(ts, x, y) -> (ts, loss)``.
+
+    ``x``/``y`` are GLOBAL batches (leading axis = global batch) sharded over
+    the data axis; params/state replicated.  Inside: local fwd+bwd on the
+    node's shard, psum+normalize grads (contributor semantics of
+    lua/AllReduceSGD.lua:18-30), SGD update, confusion-matrix update, loss
+    pmean.  Sync batchnorm: stats pmean'd across nodes, so the
+    replicated-params invariant holds bitwise.
+
+    ``with_contrib=True`` adds a 4th argument: a per-node 0/1 vector
+    ``[num_nodes]`` (sharded over the axis) marking which nodes contribute
+    this step — the uneven-data-partition case (lua/AllReduceSGD.lua:22-27).
+    Non-contributors' grads are masked out, their params still receive the
+    identical psum'd update (keeping params replicated), their step counter
+    and confusion matrix do not advance; pair with :func:`build_sync_step`
+    for the end-of-epoch winner-takes-all sync.
+    """
+    axis = tree.axis_name
+
+    def _body(ts: TrainState, x, y, contrib):
+        rng, dropout_rng = random.split(ts.rng)
+        dropout_rng = random.fold_in(dropout_rng, lax.axis_index(axis))
+
+        def _loss(p):
+            return loss_fn(model, p, ts.model_state, x, y, train=True,
+                           rng=dropout_rng, axis_name=axis, bn_weight=contrib)
+
+        (loss, (log_probs, mstate)), grads = \
+            jax.value_and_grad(_loss, has_aux=True)(ts.params)
+        sync_local = mesh_lib.squeeze_node(ts.sync)
+        grads, sync_local, n = allreduce_sgd.sum_and_normalize_gradients(
+            grads, sync_local, contrib=contrib, axis_name=axis)
+        sync = mesh_lib.expand_node(sync_local)
+        params = _sgd_update(ts.params, grads, lr)
+        cm_new = metrics_lib.update_confusion(jnp.squeeze(ts.cm, 0),
+                                              log_probs, y)
+        if contrib is not None:
+            keep = contrib.astype(jnp.bool_)
+            cm_new = jnp.where(keep, cm_new, jnp.squeeze(ts.cm, 0))
+            denom = jnp.maximum(n, 1).astype(loss.dtype)
+            mean_loss = lax.psum(loss * contrib.astype(loss.dtype), axis) / denom
+        else:
+            mean_loss = lax.pmean(loss, axis)
+        return TrainState(params, mstate, sync, cm_new[None], rng), mean_loss
+
+    specs_ts = TrainState(params=P(), model_state=P(), sync=P(axis),
+                          cm=P(axis), rng=P())
+    if with_contrib:
+        def step(ts, x, y, contrib):
+            return _body(ts, x, y, jnp.squeeze(contrib, 0))
+        in_specs = (specs_ts, P(axis), P(axis), P(axis))
+    else:
+        def step(ts, x, y):
+            return _body(ts, x, y, None)
+        in_specs = (specs_ts, P(axis), P(axis))
+    mapped = jax.shard_map(step, mesh=tree.mesh,
+                           in_specs=in_specs,
+                           out_specs=(specs_ts, P()),
+                           check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_sync_step(tree: MeshTree, donate: bool = False) -> Callable:
+    """End-of-epoch winner-takes-all parameter sync over a :class:`TrainState`
+    (ref ``synchronizeParameters``, lua/AllReduceSGD.lua:33-54): the node with
+    the most contributing steps this epoch wins; its params broadcast to all;
+    step counters reset.  Only meaningful after uneven-participation steps —
+    under full participation params are already replicated."""
+    axis = tree.axis_name
+
+    def step(ts: TrainState):
+        params, sync_local = allreduce_sgd.synchronize_parameters(
+            ts.params, mesh_lib.squeeze_node(ts.sync), axis_name=axis)
+        return ts._replace(params=params,
+                           sync=mesh_lib.expand_node(sync_local))
+
+    specs_ts = TrainState(params=P(), model_state=P(), sync=P(axis),
+                          cm=P(axis), rng=P())
+    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs_ts,),
+                           out_specs=specs_ts, check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_eval_step(model: Model, tree: MeshTree) -> Callable:
+    """Fused eval step: ``eval_step(params, mstate, cm, x, y) -> (cm, loss)``.
+    Confusion matrix stays per-node (spec ``P(axis)``); reduce with
+    :func:`reduce_confusion` at report time (ref allreduces the matrix —
+    examples/mnist.lua:122, cifar10.lua:234)."""
+    axis = tree.axis_name
+
+    def step(params, mstate, cm, x, y):
+        loss, (log_probs, _) = loss_fn(model, params, mstate, x, y,
+                                       train=False, axis_name=axis)
+        cm = metrics_lib.update_confusion(jnp.squeeze(cm, 0), log_probs, y)
+        return cm[None], lax.pmean(loss, axis)
+
+    mapped = jax.shard_map(step, mesh=tree.mesh,
+                           in_specs=(P(), P(), P(axis), P(axis), P(axis)),
+                           out_specs=(P(axis), P()),
+                           check_vma=False)
+    return jax.jit(mapped, donate_argnums=(2,))
+
+
+def reduce_confusion(cm: jax.Array):
+    """Sum stacked per-node confusion matrices ``[N, C, C]`` into one global
+    ``[C, C]`` (host-level; ref examples/mnist.lua:120-125)."""
+    import numpy as np
+    return np.asarray(jax.device_get(cm)).sum(axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Elastic averaging (EASGD) steps
+# ---------------------------------------------------------------------------
+
+class EATrainState(NamedTuple):
+    """Per-node training state for EASGD — every leaf has a leading
+    ``num_nodes`` axis sharded over the data mesh axis (nodes diverge)."""
+    params: PyTree
+    model_state: PyTree
+    center: PyTree
+    cm: jax.Array
+    rng: jax.Array
+
+
+def init_ea_state(model: Model, tree: MeshTree, key: jax.Array,
+                  num_classes: int) -> EATrainState:
+    """Identical init on every node (ref seed-0 + initial scatter —
+    examples/mnist-ea.lua:63), center := params (lua/AllReduceEA.lua:11-22)."""
+    init_key, train_key = random.split(key)
+    params, mstate = model.init(init_key)
+    n = tree.num_nodes
+    stack = lambda t: tree.put_per_node(jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t))
+    params_n = stack(params)
+    rngs = random.split(train_key, n)
+    return EATrainState(
+        params=params_n, model_state=stack(mstate),
+        center=stack(params),
+        cm=tree.put_per_node(jnp.zeros((n, num_classes, num_classes), jnp.int32)),
+        rng=tree.put_per_node(rngs))
+
+
+def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
+                   donate: bool = True) -> tuple[Callable, Callable]:
+    """Returns ``(local_step, ea_round)``.
+
+    ``local_step(ts, x, y) -> (ts, losses)`` — grad + local SGD, ZERO
+    collectives (the τ−1 quiet steps; ref examples/mnist-ea.lua:100-107).
+    BN stats stay per-node (nodes diverge anyway — matches reference, where
+    running stats are process-local buffers).
+
+    ``ea_round(ts) -> ts`` — the fused elastic round (delta, psum, center
+    move) — lua/AllReduceEA.lua:35-45 as ONE XLA program.
+    """
+    axis = tree.axis_name
+    _sq, _ex = mesh_lib.squeeze_node, mesh_lib.expand_node
+
+    def local_step(ts: EATrainState, x, y):
+        params, mstate, rng = _sq(ts.params), _sq(ts.model_state), _sq(ts.rng)
+        cm = _sq(ts.cm)
+        rng, dropout_rng = random.split(rng)
+
+        def _loss(p):
+            return loss_fn(model, p, mstate, x, y, train=True,
+                           rng=dropout_rng, axis_name=None)
+
+        (loss, (log_probs, mstate)), grads = \
+            jax.value_and_grad(_loss, has_aux=True)(params)
+        params = _sgd_update(params, grads, lr)
+        cm = metrics_lib.update_confusion(cm, log_probs, y)
+        new_ts = EATrainState(_ex(params), _ex(mstate), ts.center, _ex(cm),
+                              _ex(rng))
+        return new_ts, loss[None] if loss.ndim == 0 else loss
+
+    def ea_round(ts: EATrainState):
+        params, center = _sq(ts.params), _sq(ts.center)
+        st = allreduce_ea.EAState(center=center, step=jnp.zeros((), jnp.int32))
+        params, st = allreduce_ea.elastic_round(params, st, alpha,
+                                                axis_name=axis)
+        return EATrainState(_ex(params), ts.model_state, _ex(st.center),
+                            ts.cm, ts.rng)
+
+    spec_ts = EATrainState(params=P(axis), model_state=P(axis), center=P(axis),
+                           cm=P(axis), rng=P(axis))
+    local = jax.jit(
+        jax.shard_map(local_step, mesh=tree.mesh,
+                      in_specs=(spec_ts, P(axis), P(axis)),
+                      out_specs=(spec_ts, P(axis)), check_vma=False),
+        donate_argnums=(0,) if donate else ())
+    rnd = jax.jit(
+        jax.shard_map(ea_round, mesh=tree.mesh, in_specs=(spec_ts,),
+                      out_specs=spec_ts, check_vma=False),
+        donate_argnums=(0,) if donate else ())
+    return local, rnd

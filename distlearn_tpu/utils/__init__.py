@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: metrics, logging, flags, checkpointing, profiling
+(reference equivalents: optim.ConfusionMatrix / optim.Logger / lapp /
+colorPrint — SURVEY.md §5)."""

@@ -1,0 +1,66 @@
+"""Model zoo tests: shapes, determinism, gradient flow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import random
+
+from distlearn_tpu.models import cifar_convnet, loss_fn, mnist_cnn, param_count
+
+
+@pytest.mark.parametrize("factory,in_shape", [
+    (mnist_cnn, (32, 32, 1)),
+    (cifar_convnet, (32, 32, 3)),
+])
+def test_forward_shapes_and_logprobs(factory, in_shape):
+    model = factory()
+    params, state = model.init(random.PRNGKey(0))
+    x = random.normal(random.PRNGKey(1), (4,) + in_shape, jnp.float32)
+    log_probs, _ = model.apply(params, state, x, train=False)
+    assert log_probs.shape == (4, 10)
+    # log-softmax rows sum to 1 in prob space
+    np.testing.assert_allclose(np.exp(np.asarray(log_probs)).sum(-1), 1.0,
+                               atol=1e-5)
+
+
+def test_mnist_param_count_matches_reference_arch():
+    # conv5x5(1->16)+b, conv5x5(16->16)+b, linear(400->10)+b
+    # (ref architecture examples/mnist.lua:53-67)
+    expected = (5 * 5 * 1 * 16 + 16) + (5 * 5 * 16 * 16 + 16) + (400 * 10 + 10)
+    params, _ = mnist_cnn().init(random.PRNGKey(0))
+    assert param_count(params) == expected
+
+
+def test_init_deterministic():
+    m = mnist_cnn()
+    p1, _ = m.init(random.PRNGKey(0))
+    p2, _ = m.init(random.PRNGKey(0))
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gradients_nonzero_everywhere():
+    model = cifar_convnet()
+    params, state = model.init(random.PRNGKey(0))
+    x = random.normal(random.PRNGKey(1), (8, 32, 32, 3), jnp.float32)
+    y = jnp.arange(8) % 10
+
+    def f(p):
+        return loss_fn(model, p, state, x, y, train=True,
+                       rng=random.PRNGKey(2))[0]
+
+    grads = jax.grad(f)(params)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert float(jnp.abs(leaf).max()) > 0
+
+
+def test_batchnorm_state_updates_in_train_only():
+    model = cifar_convnet()
+    params, state = model.init(random.PRNGKey(0))
+    x = random.normal(random.PRNGKey(1), (8, 32, 32, 3), jnp.float32)
+    _, st_train = model.apply(params, state, x, train=True)
+    _, st_eval = model.apply(params, state, x, train=False)
+    m0 = np.asarray(state["bn1"]["mean"])
+    assert not np.allclose(np.asarray(st_train["bn1"]["mean"]), m0)
+    np.testing.assert_array_equal(np.asarray(st_eval["bn1"]["mean"]), m0)
