@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Headline metric (BASELINE.json "metric"): CIFAR-10 ConvNet training
+throughput in steps/sec/chip with the fused AllReduceSGD step — the
+reference's own hot path (examples/cifar10.lua per-batch loop, SURVEY.md
+§3.1) on whatever accelerator is attached (real TPU chip under the driver;
+CPU fallback elsewhere).
+
+The reference publishes no measured numbers (BASELINE.md), so
+``vs_baseline`` is reported against a modeled reference throughput: the same
+step on this host's CPU via XLA — a stand-in for the reference's
+CPU-FloatTensor path (its default; examples/cifar10.sh runs CPU nodes).
+vs_baseline > 1 means faster than the modeled baseline.
+
+Extra diagnostic metrics go to stderr; stdout carries exactly the one line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _bench_backend(batch: int, iters: int, warmup: int = 3):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.data import synthetic_cifar10
+    from distlearn_tpu.models import cifar_convnet
+    from distlearn_tpu.parallel.mesh import MeshTree
+    from distlearn_tpu.train import build_sgd_step, init_train_state
+
+    n_dev = len(jax.devices())
+    tree = MeshTree(num_nodes=n_dev)
+    platform = jax.devices()[0].platform
+    # bf16 compute on TPU (MXU path); f32 on CPU
+    model = cifar_convnet(
+        compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+    ts = init_train_state(model, tree, random.PRNGKey(0), 10)
+    step = build_sgd_step(model, tree, lr=0.1)
+
+    x, y, _ = synthetic_cifar10(batch, seed=0)
+    sh = NamedSharding(tree.mesh, P("data"))
+    bx = jax.device_put(x, sh)
+    by = jax.device_put(y, sh)
+
+    for _ in range(warmup):
+        ts, loss = step(ts, bx, by)
+    jax.block_until_ready(ts.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ts, loss = step(ts, bx, by)
+    jax.block_until_ready(ts.params)
+    dt = time.perf_counter() - t0
+    return iters / dt, n_dev, platform, float(loss)
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    steps_per_sec, n_dev, platform, loss = _bench_backend(batch, iters)
+    per_chip = steps_per_sec / max(1, n_dev)
+    print(f"[bench] platform={platform} devices={n_dev} batch={batch} "
+          f"steps/s={steps_per_sec:.3f} loss={loss:.3f}", file=sys.stderr)
+
+    # Modeled baseline: measured once on this host's CPU and cached, so TPU
+    # runs don't pay a slow CPU benchmark every time.
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_cpu_baseline.json")
+    baseline = None
+    if os.path.exists(cache):
+        try:
+            with open(cache) as fh:
+                rec = json.load(fh)
+            if rec.get("batch") == batch:   # cache only valid for same config
+                baseline = rec["steps_per_sec"]
+        except (OSError, ValueError, KeyError):
+            baseline = None
+    if baseline is None and platform == "cpu":
+        baseline = steps_per_sec
+        with open(cache, "w") as fh:
+            json.dump({"steps_per_sec": baseline, "batch": batch}, fh)
+    if baseline is None:
+        # TPU run with no cached CPU number: benchmark a short CPU run now.
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_ITERS="3",
+                   BENCH_BATCH=str(batch))
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--cpu-probe"],
+                env=env, capture_output=True, timeout=1200, text=True)
+            baseline = json.loads(out.stdout.strip().splitlines()[-1])["value"]
+            with open(cache, "w") as fh:
+                json.dump({"steps_per_sec": baseline, "batch": batch}, fh)
+        except Exception as e:  # noqa: BLE001 — bench must always print
+            print(f"[bench] cpu probe failed: {e}", file=sys.stderr)
+            baseline = None
+
+    vs = (steps_per_sec / baseline) if baseline else 1.0
+    print(json.dumps({
+        "metric": "cifar10_convnet_allreduce_sgd_steps_per_sec",
+        "value": round(steps_per_sec, 4),
+        "unit": f"steps/s (global batch {batch}, {n_dev} {platform} chip(s))",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    if "--cpu-probe" in sys.argv:
+        sps, n, plat, _ = _bench_backend(
+            int(os.environ.get("BENCH_BATCH", "256")),
+            int(os.environ.get("BENCH_ITERS", "3")), warmup=1)
+        print(json.dumps({"value": sps}))
+    else:
+        main()

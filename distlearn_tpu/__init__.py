@@ -29,11 +29,16 @@ __version__ = "0.1.0"
 from distlearn_tpu.parallel.mesh import MeshTree, all_reduce, broadcast_from, node_index
 from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
+from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
+                                             AsyncEATester)
 
 __all__ = [
     "MeshTree",
     "AllReduceSGD",
     "AllReduceEA",
+    "AsyncEAServer",
+    "AsyncEAClient",
+    "AsyncEATester",
     "all_reduce",
     "broadcast_from",
     "node_index",

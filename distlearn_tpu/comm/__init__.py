@@ -1,0 +1,9 @@
+"""Host-side transport: native C++ TCP framing + tree collectives over DCN —
+the torch-ipc replacement (SURVEY.md §2b row 1).  The TPU data plane uses XLA
+ICI collectives (distlearn_tpu.parallel.mesh); this package is the control
+plane for the asynchronous parameter-server path and multi-host side-channel.
+"""
+
+from distlearn_tpu.comm.transport import Conn, Server, connect, ProtocolError
+
+__all__ = ["Conn", "Server", "connect", "ProtocolError"]

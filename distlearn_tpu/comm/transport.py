@@ -1,0 +1,201 @@
+"""Host-side TCP transport — the torch-ipc socket layer rebuilt
+(reference consumers: ipc.server/client/recvAny — lua/AsyncEA.lua:87-220,
+examples/EASGD_server.lua:67-77).
+
+Wire protocol (shared with the native C++ backend in src/comm/distcomm.cpp):
+
+    frame   := kind:u8 | length:u64le | payload[length]
+    kind 'J': payload is UTF-8 JSON (control messages)
+    kind 'T': payload is hlen:u32le | header[hlen] | raw tensor bytes,
+              header = JSON {"dtype": str, "shape": [int...]}
+
+Connection management (listen/accept/connect/poll) stays in Python; the
+byte-moving hot path (frame assembly, big-buffer send/recv loops) dispatches
+to the native library when built (distlearn_tpu.comm.native), falling back to
+pure-Python socket IO.  ``recv_tensor(out=...)`` reuses a preallocated buffer
+— the reference's ``client:recv(buffer)`` semantics (lua/AsyncEA.lua:100-103).
+"""
+
+from __future__ import annotations
+
+import json
+import select
+import socket
+import struct
+import time
+from typing import Any
+
+import numpy as np
+
+from distlearn_tpu.comm import native
+
+_HDR = struct.Struct("<BQ")   # kind, payload length
+_THDR = struct.Struct("<I")   # tensor header length
+
+
+class Conn:
+    """A framed connection over one TCP socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._fd = sock.fileno()
+
+    # -- low-level framing --------------------------------------------------
+    def _send_frame(self, kind: int, payload: bytes | memoryview):
+        if native.available():
+            native.send_frame(self._fd, kind, payload)
+        else:
+            self.sock.sendall(_HDR.pack(kind, len(payload)))
+            self.sock.sendall(payload)
+
+    def _recv_exact(self, n: int, out: memoryview | None = None) -> memoryview:
+        buf = out if out is not None else memoryview(bytearray(n))
+        if native.available():
+            native.recv_exact(self._fd, buf, n)
+            return buf
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(buf[got:], n - got)
+            if r == 0:
+                raise ConnectionError("peer closed connection")
+            got += r
+        return buf
+
+    def _recv_frame_header(self) -> tuple[int, int]:
+        hdr = bytes(self._recv_exact(_HDR.size))
+        return _HDR.unpack(hdr)
+
+    # -- control messages ---------------------------------------------------
+    def send_msg(self, msg: Any):
+        """Send a JSON-serializable control message (ref ``client:send({q=...})``)."""
+        self._send_frame(ord("J"), json.dumps(msg).encode())
+
+    def recv_msg(self) -> Any:
+        kind, length = self._recv_frame_header()
+        payload = bytes(self._recv_exact(length))
+        if kind != ord("J"):
+            raise ProtocolError(f"expected control message, got kind {chr(kind)!r}")
+        return json.loads(payload)
+
+    # -- tensors ------------------------------------------------------------
+    def send_tensor(self, arr: np.ndarray):
+        arr = np.ascontiguousarray(arr)
+        header = json.dumps({"dtype": arr.dtype.name,
+                             "shape": list(arr.shape)}).encode()
+        meta = _THDR.pack(len(header)) + header
+        if native.available():
+            # zero-copy: numpy buffer goes straight into the writev
+            native.send_tensor_frame(self._fd, ord("T"), meta, arr)
+            return
+        self.sock.sendall(_HDR.pack(ord("T"), len(meta) + arr.nbytes))
+        self.sock.sendall(meta)
+        self.sock.sendall(memoryview(arr).cast("B"))
+
+    def recv_tensor(self, out: np.ndarray | None = None) -> np.ndarray:
+        kind, length = self._recv_frame_header()
+        if kind != ord("T"):
+            raise ProtocolError(f"expected tensor, got kind {chr(kind)!r}")
+        hlen = _THDR.unpack(bytes(self._recv_exact(_THDR.size)))[0]
+        header = json.loads(bytes(self._recv_exact(hlen)))
+        nbytes = length - _THDR.size - hlen
+        dtype = np.dtype(header["dtype"])
+        shape = tuple(header["shape"])
+        if out is not None:
+            if out.dtype != dtype or out.shape != shape:
+                raise ValueError(
+                    f"recv buffer mismatch: have {out.dtype}{out.shape}, "
+                    f"got {dtype}{shape}")
+            if not (out.flags.c_contiguous and out.flags.writeable):
+                tmp = np.empty(shape, dtype)
+                self._recv_exact(nbytes, memoryview(tmp).cast("B"))
+                out[...] = tmp
+                return out
+            self._recv_exact(nbytes, memoryview(out).cast("B"))
+            return out
+        arr = np.empty(shape, dtype)
+        if nbytes:
+            self._recv_exact(nbytes, memoryview(arr).cast("B"))
+        return arr
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+class Server:
+    """Listening endpoint (ref ``ipc.server(host, port)``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(128)
+        self.host, self.port = self.sock.getsockname()
+        self.conns: list[Conn] = []
+
+    def accept(self, n: int = 1, timeout: float | None = None) -> list[Conn]:
+        """Accept ``n`` connections (ref ``server:clients(n, fn)`` accept side)."""
+        new = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for _ in range(n):
+            if deadline is not None:
+                self.sock.settimeout(max(0.0, deadline - time.monotonic()))
+            c, _ = self.sock.accept()
+            conn = Conn(c)
+            self.conns.append(conn)
+            new.append(conn)
+        self.sock.settimeout(None)
+        return new
+
+    def recv_any(self, timeout: float | None = None) -> tuple[int, Any]:
+        """Wait for a control message from ANY accepted connection — the
+        server's select-like wait (ref ``serverBroadcast:recvAny()``,
+        lua/AsyncEA.lua:168).  Returns ``(conn_index, msg)``.
+
+        Peers that have closed (EOF) are dropped and the wait continues with
+        the remaining connections — a client finishing its epochs must not
+        wedge the server while other clients still sync.
+        """
+        while True:
+            live = {c.sock: i for i, c in enumerate(self.conns)
+                    if c.sock.fileno() >= 0}
+            if not live:
+                raise RuntimeError("no open connections")
+            ready, _, _ = select.select(list(live), [], [], timeout)
+            if not ready:
+                raise TimeoutError("recv_any timed out")
+            for sock in ready:
+                i = live[sock]
+                try:
+                    return i, self.conns[i].recv_msg()
+                except ConnectionError:
+                    self.conns[i].close()  # EOF: drop peer, keep waiting
+
+    def close(self):
+        for c in self.conns:
+            c.close()
+        self.sock.close()
+
+
+def connect(host: str, port: int, retries: int = 60,
+            retry_interval: float = 0.25) -> Conn:
+    """Client-side connect with retry — the reference launch scripts start
+    server and clients concurrently, so clients must tolerate a not-yet-
+    listening server (examples/AsyncEASGD.sh backgrounds everything)."""
+    last: Exception | None = None
+    for _ in range(retries):
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect((host, port))
+            return Conn(s)
+        except OSError as e:
+            last = e
+            time.sleep(retry_interval)
+    raise ConnectionError(f"could not connect to {host}:{port}: {last}")

@@ -3,6 +3,8 @@
 from distlearn_tpu.parallel.mesh import MeshTree, all_reduce, broadcast_from, node_index
 from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
+from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
+                                             AsyncEATester)
 
 __all__ = [
     "MeshTree",
@@ -11,4 +13,7 @@ __all__ = [
     "node_index",
     "AllReduceSGD",
     "AllReduceEA",
+    "AsyncEAServer",
+    "AsyncEAClient",
+    "AsyncEATester",
 ]

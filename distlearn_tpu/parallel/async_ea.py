@@ -1,0 +1,226 @@
+"""Asynchronous EASGD over a hub-and-spoke parameter server — the TPU-native
+rebuild of lua/AsyncEA.lua.
+
+Three roles (reference export surface lua/AsyncEA.lua:294-303):
+
+* **server** — holds the authoritative center variable pinned host-side, does
+  no training; admits ONE client at a time through the ``Enter?``/``Enter``
+  critical section (lua :163-177), streams the center, receives the elastic
+  delta, applies ``center += delta`` (lua :198-228).
+* **client** — trains locally; every ``tau``-th step runs the sync handshake:
+  ``Enter?`` → fetch center → local elastic move ``delta=(p-c)*alpha;
+  p-=delta`` (lua :109-119) → push delta.
+* **tester** — a dedicated evaluation process the server pushes the center to
+  every ``testTime`` syncs (lua :239-292).
+
+Socket topology (examples/EASGD_server.lua:67-77): broadcast channel on
+``port`` (all clients), one dedicated per-client channel on ``port + i``,
+test channel on ``port + numNodes + 1``.
+
+TPU-native stance: genuinely asynchronous point-to-point against a live
+center does not fit the SPMD/XLA model, so this is the one subsystem built on
+the host-side transport (C++ framing hot path, distlearn_tpu.comm) rather
+than ICI collectives — exactly mirroring where the reference was native
+(SURVEY.md §7 "hard parts").  Device↔host staging happens only at the
+``tau``-spaced sync points, so the hot local-step loop stays on-device.
+
+Params cross this API as pytrees; leaves are converted with ``np.asarray`` /
+left as numpy — callers using jax arrays get numpy back and re-place onto
+device (see examples/easgd_client.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from distlearn_tpu.comm import Conn, ProtocolError, Server, connect
+from distlearn_tpu.utils.logging import print_client, print_server, print_tester
+
+PyTree = Any
+
+ENTER_Q = "Enter?"
+ENTER = "Enter"
+CENTER_Q = "Center?"
+DELTA_Q = "delta?"
+DELTA = "delta"
+TEST_Q = "Test?"
+ACK = "Ack"
+
+
+def _leaves(tree: PyTree) -> list[np.ndarray]:
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _rebuild(tree: PyTree, leaves: list[np.ndarray]) -> PyTree:
+    treedef = jax.tree_util.tree_structure(tree)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _expect(conn: Conn, want: str):
+    """Protocol step check — explicit (never stripped under ``python -O``,
+    unlike the reference's asserts) and diagnostic on desync."""
+    got = conn.recv_msg()
+    if got != want:
+        raise ProtocolError(f"protocol desync: expected {want!r}, got {got!r}")
+
+
+class AsyncEAServer:
+    """Parameter-server role (ref initServer/syncServer/testNet)."""
+
+    def __init__(self, host: str, port: int, num_nodes: int,
+                 with_tester: bool = False, accept_timeout: float = 120.0):
+        self.num_nodes = num_nodes
+        # Broadcast channel: all clients connect here (EASGD_server.lua:67-68).
+        self.broadcast = Server(host, port)
+        # Dedicated per-client channels on port+i (EASGD_server.lua:71-77).
+        self.dedicated_servers = [Server(host, port + i + 1)
+                                  for i in range(num_nodes)]
+        # Test channel on port+numNodes+1 (EASGD_server.lua:69-70).
+        self.test_server = Server(host, port + num_nodes + 1) \
+            if with_tester else None
+        self.broadcast.accept(num_nodes, timeout=accept_timeout)
+        self.dedicated: list[Conn] = []
+        for s in self.dedicated_servers:
+            self.dedicated.append(s.accept(1, timeout=accept_timeout)[0])
+        self.test_conn = self.test_server.accept(1, timeout=accept_timeout)[0] \
+            if with_tester else None
+        self.center: list[np.ndarray] | None = None
+        self.current_client: int | None = None
+
+    def init_server(self, params: PyTree):
+        """Clone params as center, broadcast it to every client
+        (ref lua :150-160)."""
+        self.center = [x.copy() for x in _leaves(params)]
+        for conn in self.broadcast.conns:
+            for t in self.center:
+                conn.send_tensor(t)
+
+    def sync_server(self, params: PyTree) -> PyTree:
+        """One full server-side sync round (ref ``syncServer``, lua :230-237):
+        admit one client, send center, receive delta, apply it, and copy the
+        center into the server-local params (returned)."""
+        # serverEnterSync (lua :163-177): critical section — one client only.
+        _, msg = self.broadcast.recv_any()
+        if not isinstance(msg, dict) or msg.get("q") != ENTER_Q:
+            raise ProtocolError(f"expected {ENTER_Q!r} request, got {msg!r}")
+        cid = int(msg.get("clientID", -1))
+        if not 1 <= cid <= self.num_nodes:
+            raise ProtocolError(
+                f"clientID {cid} out of range 1..{self.num_nodes}")
+        self.current_client = cid
+        conn = self.dedicated[cid - 1]  # 1-based ids (ref)
+        conn.send_msg(ENTER)
+        print_server(f"current client is #{self.current_client}")
+
+        # serverSendCenter (lua :180-196)
+        _expect(conn, CENTER_Q)
+        for t in self.center:
+            conn.send_tensor(t)
+
+        # serverGetUpdateDiff (lua :198-228)
+        _expect(conn, DELTA_Q)
+        conn.send_msg(DELTA)
+        for t in self.center:
+            delta = conn.recv_tensor()
+            t += delta.astype(t.dtype)
+        print_server(f"received delta from client #{self.current_client}")
+        return _rebuild(params, [t.copy() for t in self.center])
+
+    def test_net(self):
+        """Push the center to the tester (ref ``testNet``, lua :239-258)."""
+        conn = self.test_conn
+        conn.send_msg(TEST_Q)
+        _expect(conn, CENTER_Q)
+        for t in self.center:
+            conn.send_tensor(t)
+        _expect(conn, ACK)
+
+    def close(self):
+        self.broadcast.close()
+        for s in self.dedicated_servers:
+            s.close()
+        if self.test_server:
+            self.test_server.close()
+
+
+class AsyncEAClient:
+    """Worker role (ref initClient/syncClient)."""
+
+    def __init__(self, host: str, port: int, node: int, tau: int, alpha: float):
+        if node < 1:
+            raise ValueError("node is 1-based (reference convention)")
+        self.node = node
+        self.tau = int(tau)
+        self.alpha = float(alpha)
+        self.step = 0
+        # clientBroadcast -> port; dedicated client -> port+node
+        # (EASGD_client.lua:58-61).
+        self.broadcast = connect(host, port)
+        self.conn = connect(host, port + node)
+        self.center: list[np.ndarray] | None = None
+
+    def init_client(self, params: PyTree) -> PyTree:
+        """Receive the initial center from the server's broadcast; params :=
+        center (ref lua :64-78)."""
+        leaves = _leaves(params)
+        self.center = [self.broadcast.recv_tensor() for _ in leaves]
+        return _rebuild(params, [c.copy() for c in self.center])
+
+    def sync_client(self, params: PyTree) -> tuple[PyTree, bool]:
+        """Every ``tau``-th call: full sync handshake (ref ``syncClient``,
+        lua :134-146).  Returns ``(new_params, synced)``."""
+        self.step += 1
+        if self.step % self.tau != 0:   # isSyncNeeded (lua :47-57)
+            return params, False
+
+        # clientEnterSync (lua :82-92)
+        print_client(self.node, "waiting to sync")
+        self.broadcast.send_msg({"q": ENTER_Q, "clientID": self.node})
+        _expect(self.conn, ENTER)
+        # clientGetCenter (lua :95-106)
+        self.conn.send_msg(CENTER_Q)
+        self.center = [self.conn.recv_tensor(out=c) for c in self.center]
+        # calculateUpdateDiff (lua :109-119): local EA math
+        leaves = _leaves(params)
+        deltas = [(p - c) * np.asarray(self.alpha, p.dtype)
+                  for p, c in zip(leaves, self.center)]
+        new_leaves = [p - d for p, d in zip(leaves, deltas)]
+        # clientSendDiff (lua :122-132)
+        self.conn.send_msg(DELTA_Q)
+        _expect(self.conn, DELTA)
+        for d in deltas:
+            self.conn.send_tensor(d)
+        print_client(self.node, "synced")
+        return _rebuild(params, new_leaves), True
+
+    def close(self):
+        self.broadcast.close()
+        self.conn.close()
+
+
+class AsyncEATester:
+    """Evaluation role (ref initTester/startTest/finishTest)."""
+
+    def __init__(self, host: str, port: int, num_nodes: int):
+        # test channel on port+numNodes+1 (EASGD_tester.lua:64)
+        self.conn = connect(host, port + num_nodes + 1)
+
+    def start_test(self, params: PyTree) -> PyTree:
+        """Block until the server pushes ``Test?``; fetch center into params
+        (ref lua :268-285)."""
+        _expect(self.conn, TEST_Q)
+        self.conn.send_msg(CENTER_Q)
+        leaves = _leaves(params)
+        new = [self.conn.recv_tensor() for _ in leaves]
+        print_tester("received center for evaluation")
+        return _rebuild(params, new)
+
+    def finish_test(self):
+        """Ack the round so the server resumes (ref lua :287-292)."""
+        self.conn.send_msg(ACK)
+
+    def close(self):
+        self.conn.close()

@@ -1,0 +1,76 @@
+"""Tracing / profiling — the reference has none beyond xlua.progress bars
+(SURVEY.md §5); here: ``jax.profiler`` trace capture plus lightweight
+per-step wall-clock timers suitable for the bench harness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA profiler trace viewable in TensorBoard/Perfetto."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step timing with warmup discard.
+
+    Call ``tick()`` around synchronized step boundaries (the caller is
+    responsible for ``block_until_ready`` on the final step of a window —
+    async dispatch means intermediate ticks measure dispatch, which is the
+    desired steady-state number).
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self._times: list[float] = []
+        self._last: float | None = None
+
+    def tick(self):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    @property
+    def steps(self) -> int:
+        return max(0, len(self._times) - self.warmup)
+
+    def mean(self) -> float:
+        xs = self._times[self.warmup:]
+        return float(np.mean(xs)) if xs else float("nan")
+
+    def p50(self) -> float:
+        xs = self._times[self.warmup:]
+        return float(np.median(xs)) if xs else float("nan")
+
+    def steps_per_sec(self) -> float:
+        m = self.mean()
+        return 1.0 / m if m and m == m and m > 0 else float("nan")
+
+
+class Progress:
+    """xlua.progress stand-in: single-line progress meter on the root node."""
+
+    def __init__(self, total: int, enabled: bool = True, width: int = 30):
+        self.total, self.enabled, self.width = total, enabled, width
+
+    def update(self, i: int, suffix: str = ""):
+        if not self.enabled or self.total <= 0:
+            return
+        frac = min(1.0, (i + 1) / self.total)
+        filled = int(self.width * frac)
+        bar = "=" * filled + ">" + "." * (self.width - filled - 1)
+        end = "\n" if i + 1 >= self.total else "\r"
+        print(f" [{bar[:self.width]}] {i + 1}/{self.total} {suffix}",
+              end=end, flush=True)

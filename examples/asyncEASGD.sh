@@ -1,0 +1,31 @@
+#!/bin/bash
+# Counterpart of examples/AsyncEASGD.sh: parameter server + tester + 2
+# worker clients on localhost.  The reference kills stale ports with fuser
+# and derives the server IP from ifconfig; localhost + fresh port suffices
+# here (multi-host: pass --host/--port to each role).
+cd "$(dirname "$0")"
+PORT=${PORT:-9500}
+NODES=2
+EPOCHS=${EPOCHS:-1}
+BATCH=${BATCH:-16}
+N=${N:-256}
+MODEL=${MODEL:-mnist}
+TAU=${TAU:-4}
+# steps/epoch = (N/NODES)/BATCH; syncs = NODES*EPOCHS*(steps/tau)
+STEPS_PER_EPOCH=$(( (N / NODES) / BATCH ))
+SYNCS=$(( NODES * EPOCHS * (STEPS_PER_EPOCH / TAU) ))
+TESTTIME=${TESTTIME:-4}
+NUMTESTS=$(( SYNCS / TESTTIME + 1 ))
+
+common="--numNodes $NODES --port $PORT --numEpochs $EPOCHS --batchSize $BATCH \
+  --numExamples $N --communicationTime $TAU --model $MODEL"
+
+python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS &
+SERVER=$!
+python easgd_tester.py $common --numTests $NUMTESTS &
+TESTER=$!
+python easgd_client.py $common --nodeIndex 1 --verbose &
+C1=$!
+python easgd_client.py $common --nodeIndex 2 --verbose &
+C2=$!
+wait $SERVER $TESTER $C1 $C2

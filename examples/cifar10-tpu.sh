@@ -1,0 +1,6 @@
+#!/bin/bash
+# Reference parity: examples/cifar10-cuda.sh (4 nodes, one GPU each) ->
+# TPU mesh. On a single-chip host this runs 1 node; on a pod slice the mesh
+# spans all local chips.
+cd "$(dirname "$0")"
+python cifar10.py --numNodes ${NUM_NODES:-1} --tpu --batchSize 256 "$@"

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Distributed CIFAR-10 convnet training with AllReduceSGD — the TPU-native
+counterpart of examples/cifar10.lua (the reference's --cuda path becomes
+--tpu; BASELINE.json north star).
+
+Reference parity: 5-block convnet (cifar10.lua:100-163 == our
+cifar_convnet), per-node batch ceil(B/N) (cifar10.lua:36), label-uniform
+sampling (cifar10.lua:53-72), lr 0.1, per-epoch test pass with an allreduced
+confusion matrix (cifar10.lua:210-236); checkpoint/resume added per
+SURVEY.md §5.
+
+Run:  python examples/cifar10.py --numNodes 4 --batchSize 128 [--tpu]
+"""
+
+from __future__ import annotations
+
+from common import setup_platform, device_stream
+from distlearn_tpu.utils.flags import parse_flags, NODE_FLAGS, TRAIN_FLAGS
+
+
+def main():
+    opt = parse_flags("Train a CIFAR-10 classifier.", {
+        **NODE_FLAGS,
+        **TRAIN_FLAGS,
+        "batchSize": (128, "global batch size"),
+        "data": ("", "path to .npz with x [N,32,32,3]/y (default: synthetic)"),
+        "numExamples": (8192, "synthetic dataset size"),
+        "testExamples": (1024, "synthetic test-set size"),
+        "save": ("", "checkpoint dir (empty = off)"),
+        "resume": (False, "resume from newest checkpoint in --save"),
+        "bf16": (False, "bfloat16 compute (MXU path)"),
+    })
+    setup_platform(opt.numNodes, opt.tpu)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.data import (LabelUniformSampler, PermutationSampler,
+                                    load_npz, make_dataset, synthetic_cifar10)
+    from distlearn_tpu.models import cifar_convnet
+    from distlearn_tpu.parallel.mesh import MeshTree
+    from distlearn_tpu.train import (build_eval_step, build_sgd_step,
+                                     build_sync_step, init_train_state,
+                                     reduce_confusion)
+    from distlearn_tpu.utils import checkpoint as ckpt
+    from distlearn_tpu.utils import metrics as M
+    from distlearn_tpu.utils.logging import root_print
+    from distlearn_tpu.utils.profiling import StepTimer
+
+    log = root_print(0)
+    tree = MeshTree(num_nodes=opt.numNodes)
+    log(f"mesh: {tree.num_nodes} nodes on {jax.devices()[0].platform}")
+
+    if opt.data:
+        x, y, nc = load_npz(opt.data)
+        n_test = max(1, len(y) // 10)
+        xte, yte = x[-n_test:], y[-n_test:]
+        x, y = x[:-n_test], y[:-n_test]
+    else:
+        x, y, nc = synthetic_cifar10(opt.numExamples, seed=opt.seed)
+        xte, yte, _ = synthetic_cifar10(opt.testExamples, seed=opt.seed + 1)
+    ds = make_dataset(x, y, nc)
+    ds_test = make_dataset(xte, yte, nc)
+
+    model = cifar_convnet(
+        compute_dtype=jnp.bfloat16 if opt.bf16 else None)
+    ts = init_train_state(model, tree, random.PRNGKey(opt.seed), nc)
+    step = build_sgd_step(model, tree, lr=opt.learningRate)
+    sync = build_sync_step(tree)
+    ev = build_eval_step(model, tree)
+
+    start_epoch = 1
+    if opt.resume and opt.save and ckpt.latest_step(opt.save) is not None:
+        restorable = {"params": ts.params, "model_state": ts.model_state}
+        restored, meta = ckpt.restore_checkpoint(opt.save, restorable)
+        ts = ts._replace(params=restored["params"],
+                         model_state=restored["model_state"])
+        start_epoch = meta["step"] + 1
+        log(f"resumed from epoch {meta['step']}")
+
+    timer = StepTimer()
+    for epoch in range(start_epoch, opt.numEpochs + 1):
+        sampler = LabelUniformSampler(ds.y, seed=opt.seed + epoch)
+        for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
+            timer.tick()
+            ts, loss = step(ts, bx, by)
+        ts = sync(ts)
+        train_cm = reduce_confusion(ts.cm)
+        ts = ts._replace(cm=jax.tree_util.tree_map(lambda c: c * 0, ts.cm))
+
+        # per-epoch test pass with allreduced confusion (cifar10.lua:210-236)
+        cm = jax.device_put(
+            jnp.zeros((tree.num_nodes, nc, nc), jnp.int32),
+            NamedSharding(tree.mesh, P(tree.axis_name)))
+        tsampler = PermutationSampler(ds_test.size, seed=0)
+        for bx, by in device_stream(tree, ds_test, tsampler, opt.batchSize):
+            cm, test_loss = ev(ts.params, ts.model_state, cm, bx, by)
+        log(f"epoch {epoch}: train {M.format_confusion(train_cm)} | "
+            f"test {M.format_confusion(reduce_confusion(cm))} "
+            f"({timer.steps_per_sec():.2f} steps/s)")
+
+        if opt.save:
+            ckpt.save_checkpoint(
+                opt.save, epoch,
+                {"params": ts.params, "model_state": ts.model_state},
+                metadata={"epoch": epoch})
+    jax.block_until_ready(ts.params)
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+#!/bin/bash
+# Reference parity: examples/cifar10.sh (2 CPU nodes).
+cd "$(dirname "$0")"
+python cifar10.py --numNodes 2 --numEpochs 2 "$@"

@@ -1,0 +1,47 @@
+"""Shared example plumbing (the reference's examples share Data.lua/Model.lua;
+here: platform setup + data/stream helpers).
+
+One SPMD process drives ALL nodes: where the reference launches N OS
+processes connected by TCP (examples/mnist.sh spawning ``th mnist.lua
+--nodeIndex i &``), a JAX program places one program over an N-device mesh.
+``--numNodes`` picks the mesh size; ``--nodeIndex`` is accepted for CLI
+parity and used only to label multi-host processes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup_platform(num_nodes: int, tpu: bool):
+    """Pick the backend BEFORE any device query.
+
+    --tpu: use the real TPU backend (devices as-is).  Otherwise: CPU with
+    ``num_nodes`` virtual host devices (the reference's LocalhostTree
+    analogue, SURVEY.md §4).
+    """
+    if tpu:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={num_nodes}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def data_sharding(tree):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(tree.mesh, P(tree.axis_name))
+
+
+def device_stream(tree, ds, sampler, batch, prefetch=2):
+    from distlearn_tpu.data import batch_iterator, prefetch_to_device
+    sh = data_sharding(tree)
+    return prefetch_to_device(batch_iterator(ds, sampler, batch),
+                              size=prefetch, sharding=sh)

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""AsyncEA worker process — counterpart of examples/EASGD_client.lua.
+
+Local training on this process's data partition; every ``--communicationTime``
+steps the client runs the sync handshake against the parameter server.  Note
+the reference ordering kept here: the sync happens BETWEEN gradient
+computation and the local SGD update (EASGD_client.lua:106-117).
+
+Run:  python easgd_client.py --nodeIndex 1 --numNodes 2 --port 9500 ...
+"""
+
+from __future__ import annotations
+
+from easgd_common import build_model_and_data, setup_platform, DATA_FLAGS
+from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
+                                       EA_FLAGS, ASYNC_FLAGS)
+
+
+def main():
+    opt = parse_flags("EASGD worker client.", {
+        **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
+    })
+    setup_platform(1, opt.tpu)
+
+    import jax
+    import numpy as np
+    from jax import random
+
+    from distlearn_tpu.data import PermutationSampler, batch_iterator
+    from distlearn_tpu.models.core import loss_fn
+    from distlearn_tpu.parallel.async_ea import AsyncEAClient
+    from distlearn_tpu.utils.logging import print_client, set_verbose
+
+    set_verbose(opt.verbose)
+    model, params, mstate, ds, nc = build_model_and_data(
+        opt, partition=opt.nodeIndex - 1, partitions=opt.numNodes)
+
+    client = AsyncEAClient(opt.host, opt.port, node=opt.nodeIndex,
+                           tau=opt.communicationTime, alpha=opt.alpha)
+    params = client.init_client(params)
+
+    @jax.jit
+    def grad_step(p, s, x, y, rng):
+        (loss, (_, new_s)), grads = jax.value_and_grad(
+            lambda pp: loss_fn(model, pp, s, x, y, train=True, rng=rng),
+            has_aux=True)(p)
+        return grads, new_s, loss
+
+    @jax.jit
+    def apply_sgd(p, g):
+        return jax.tree_util.tree_map(
+            lambda pp, gg: pp - np.float32(opt.learningRate) * gg, p, g)
+
+    rng = random.PRNGKey(opt.seed + opt.nodeIndex)
+    step = 0
+    for epoch in range(1, opt.numEpochs + 1):
+        sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
+        for bx, by in batch_iterator(ds, sampler, opt.batchSize):
+            rng, sub = random.split(rng)
+            grads, mstate, loss = grad_step(params, mstate, bx, by, sub)
+            # sync BETWEEN grads and update (EASGD_client.lua:109 then :113)
+            params, synced = client.sync_client(params)
+            params = apply_sgd(params, grads)
+            step += 1
+            if synced:
+                print_client(opt.nodeIndex,
+                             f"step {step} loss {float(loss):.4f} (synced)")
+    print_client(opt.nodeIndex, "done")
+    client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Shared pieces for the AsyncEA (EASGD) client/server/tester trio —
+the counterpart of the reference's shared examples/Model.lua +
+examples/Data.lua used by EASGD_{server,client,tester}.lua.
+
+Every role builds the SAME model with the SAME seed (ref Model.lua:17
+``torch.manualSeed(0)``) and then the server's initial center broadcast makes
+init exact (ref AsyncEA.lua:150-160).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from common import setup_platform  # noqa: E402  (re-export)
+
+
+def build_model_and_data(opt, partition: int = 0, partitions: int = 1):
+    """Model + partitioned data (ref Model.lua / Data.lua).  ``--model cifar``
+    is the reference's convnet; ``--model mnist`` is the cheap CNN for smoke
+    runs on CPU."""
+    from jax import random
+
+    from distlearn_tpu.data import (load_npz, make_dataset, synthetic_cifar10,
+                                    synthetic_mnist)
+    from distlearn_tpu.models import cifar_convnet, mnist_cnn
+
+    synth = synthetic_cifar10 if opt.model == "cifar" else synthetic_mnist
+    if opt.data:
+        x, y, nc = load_npz(opt.data)
+    else:
+        x, y, nc = synth(opt.numExamples, seed=opt.seed)
+    ds = make_dataset(x, y, nc, partition=partition, partitions=partitions)
+
+    model = cifar_convnet() if opt.model == "cifar" else mnist_cnn()
+    params, mstate = model.init(random.PRNGKey(opt.seed))
+    return model, params, mstate, ds, nc
+
+
+DATA_FLAGS = {
+    "data": ("", "path to .npz dataset (default: synthetic)"),
+    "numExamples": (2048, "synthetic dataset size"),
+    "model": ("cifar", "model family: cifar (reference convnet) | mnist"),
+}

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""AsyncEA parameter-server process — counterpart of examples/EASGD_server.lua.
+
+Holds the authoritative center variable, admits one client at a time, applies
+elastic deltas, pushes the center to the tester every ``--testTime`` syncs
+(EASGD_server.lua:118-128).  Does no training.  Checkpoints the center
+(first-class here; commented-out in the reference, EASGD_server.lua:37-48).
+
+Run:  python easgd_server.py --numNodes 2 --port 9500 [--tester] ...
+"""
+
+from __future__ import annotations
+
+from easgd_common import build_model_and_data, setup_platform, DATA_FLAGS
+from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
+                                       EA_FLAGS, ASYNC_FLAGS)
+
+
+def main():
+    opt = parse_flags("EASGD parameter server.", {
+        **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
+        "numSyncs": (0, "total syncs to serve (0 = numEpochs*steps/tau per node)"),
+        "tester": (False, "open the test channel and expect a tester process"),
+    })
+    setup_platform(1, opt.tpu)
+
+    from distlearn_tpu.parallel.async_ea import AsyncEAServer
+    from distlearn_tpu.utils import checkpoint as ckpt
+    from distlearn_tpu.utils.logging import print_server, set_verbose
+
+    set_verbose(True)  # server logs are the reference's printServer
+    model, params, mstate, ds, nc = build_model_and_data(opt)
+
+    # Each client trains on a 1/numNodes partition and syncs every tau of its
+    # own continuously-counted steps, so the server must expect exactly
+    # numNodes * (total_client_steps // tau) handshakes.
+    per_client_steps = (ds.size // opt.numNodes) // max(1, opt.batchSize)
+    num_syncs = opt.numSyncs or (
+        opt.numNodes * ((opt.numEpochs * per_client_steps)
+                        // opt.communicationTime))
+    print_server(f"serving {opt.numNodes} clients, {num_syncs} syncs, "
+                 f"tester={opt.tester}")
+
+    srv = AsyncEAServer(opt.host, opt.port, opt.numNodes,
+                        with_tester=opt.tester)
+    srv.init_server(params)
+    for i in range(1, num_syncs + 1):
+        params = srv.sync_server(params)
+        if opt.tester and i % opt.testTime == 0:
+            srv.test_net()
+        if opt.save and i % (opt.testTime * 2) == 0:
+            ckpt.save_checkpoint(opt.save, i, {"center": params})
+    if opt.tester:
+        srv.test_net()  # final eval push
+    if opt.save:
+        ckpt.save_checkpoint(opt.save, num_syncs, {"center": params})
+    print_server("done")
+    srv.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""AsyncEA evaluation process — counterpart of examples/EASGD_tester.lua.
+
+Blocks on the test channel; every server push it evaluates the center on the
+train and test sets, appends error rates to a JSONL log (the reference's
+optim.Logger + gnuplot plots, EASGD_tester.lua:40-47,161-165), and acks.
+
+Run:  python easgd_tester.py --numNodes 2 --port 9500 --numTests 5 ...
+"""
+
+from __future__ import annotations
+
+from easgd_common import build_model_and_data, setup_platform, DATA_FLAGS
+from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
+                                       EA_FLAGS, ASYNC_FLAGS)
+
+
+def main():
+    opt = parse_flags("EASGD evaluation process.", {
+        **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
+        "numTests": (5, "number of test rounds to serve before exiting"),
+        "log": ("", "JSONL metrics path (default: <save>/tester.jsonl or off)"),
+    })
+    setup_platform(1, opt.tpu)
+
+    import jax
+    import numpy as np
+    from jax import random
+
+    from distlearn_tpu.data import (PermutationSampler, batch_iterator,
+                                    make_dataset, synthetic_cifar10,
+                                    synthetic_mnist)
+    from distlearn_tpu.parallel.async_ea import AsyncEATester
+    from distlearn_tpu.utils import metrics as M
+    from distlearn_tpu.utils.logging import (MetricsLogger, print_tester,
+                                             set_verbose)
+
+    set_verbose(True)
+    model, params, mstate, ds, nc = build_model_and_data(opt)
+    synth = synthetic_cifar10 if opt.model == "cifar" else synthetic_mnist
+    xte, yte, _ = synth(max(256, opt.numExamples // 4), seed=opt.seed + 1)
+    ds_test = make_dataset(xte, yte, nc)
+
+    log_path = opt.log or (f"{opt.save}/tester.jsonl" if opt.save else None)
+    logger = MetricsLogger(log_path)
+
+    @jax.jit
+    def eval_batch(p, s, x, y):
+        log_probs, _ = model.apply(p, s, x, train=False)
+        return log_probs
+
+    def error_rate(p, s, dset):
+        cm = np.zeros((nc, nc), np.int64)
+        sampler = PermutationSampler(dset.size, seed=0)
+        for bx, by in batch_iterator(dset, sampler, opt.batchSize):
+            lp = np.asarray(eval_batch(p, s, bx, by))
+            preds = lp.argmax(-1)
+            np.add.at(cm, (by, preds), 1)
+        return 1.0 - M.total_valid(cm)
+
+    tester = AsyncEATester(opt.host, opt.port, opt.numNodes)
+    for round_i in range(1, opt.numTests + 1):
+        params = tester.start_test(params)   # blocks for server push
+        train_err = error_rate(params, mstate, ds)
+        test_err = error_rate(params, mstate, ds_test)
+        rec = logger.add(round=round_i, train_error=train_err,
+                         test_error=test_err)
+        print_tester(f"round {round_i}: train_err={train_err:.4f} "
+                     f"test_err={test_err:.4f}")
+        tester.finish_test()
+    print_tester("done")
+    logger.close()
+    tester.close()
+
+
+if __name__ == "__main__":
+    main()

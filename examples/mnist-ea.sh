@@ -1,0 +1,4 @@
+#!/bin/bash
+# Reference parity: examples/mnist-ea.sh (4 nodes, elastic averaging).
+cd "$(dirname "$0")"
+python mnist_ea.py --numNodes 4 --numEpochs 4 "$@"

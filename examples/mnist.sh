@@ -1,0 +1,5 @@
+#!/bin/bash
+# Reference parity: examples/mnist.sh launches 4 node processes; the
+# TPU-native framework drives a 4-node mesh from one SPMD program.
+cd "$(dirname "$0")"
+python mnist.py --numNodes 4 --numEpochs 4 "$@"

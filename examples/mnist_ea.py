@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Distributed MNIST training with elastic averaging (AllReduceEA) — the
+TPU-native counterpart of examples/mnist-ea.lua.
+
+Reference cadence (SURVEY.md §3.2): one initial parameter sync
+(mnist-ea.lua:63), per-step local SGD — collective-free — then every
+``tau``-th step the fused elastic round (mnist-ea.lua:110,
+lua/AllReduceEA.lua:31-45), end-of-epoch ``synchronizeCenter`` drift repair
+(mnist-ea.lua:121).  tau=10 alpha=0.2 defaults match mnist-ea.lua:18.
+
+Run:  python examples/mnist_ea.py --numNodes 4 [--tpu]
+"""
+
+from __future__ import annotations
+
+from common import setup_platform, device_stream
+from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
+                                       EA_FLAGS)
+
+
+def main():
+    opt = parse_flags("Train MNIST with elastic averaging.", {
+        **NODE_FLAGS,
+        **TRAIN_FLAGS,
+        **EA_FLAGS,
+        "learningRate": (0.01, "learning rate"),
+        "data": ("", "path to .npz (default: synthetic)"),
+        "numExamples": (4096, "synthetic dataset size"),
+        "reportEvery": (100, "steps between reports"),
+    })
+    setup_platform(opt.numNodes, opt.tpu)
+
+    import jax
+    import numpy as np
+    from jax import random
+
+    from distlearn_tpu.data import (PermutationSampler, load_npz, make_dataset,
+                                    synthetic_mnist)
+    from distlearn_tpu.models import mnist_cnn
+    from distlearn_tpu.parallel import allreduce_ea
+    from distlearn_tpu.parallel.mesh import MeshTree
+    from distlearn_tpu.train import (build_ea_steps, init_ea_state,
+                                     reduce_confusion)
+    from distlearn_tpu.utils import metrics as M
+    from distlearn_tpu.utils.logging import root_print
+    from distlearn_tpu.utils.profiling import StepTimer
+
+    log = root_print(0)
+    tree = MeshTree(num_nodes=opt.numNodes)
+    log(f"mesh: {tree.num_nodes} nodes on {jax.devices()[0].platform}")
+
+    if opt.data:
+        x, y, nc = load_npz(opt.data)
+    else:
+        x, y, nc = synthetic_mnist(opt.numExamples, seed=opt.seed)
+    ds = make_dataset(x, y, nc)
+
+    model = mnist_cnn()
+    ets = init_ea_state(model, tree, random.PRNGKey(opt.seed), nc)
+    local_step, ea_round = build_ea_steps(model, tree, lr=opt.learningRate,
+                                          alpha=opt.alpha)
+    tau = opt.communicationTime
+
+    timer = StepTimer()
+    global_step = 0
+    for epoch in range(1, opt.numEpochs + 1):
+        sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
+        for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
+            timer.tick()
+            ets, losses = local_step(ets, bx, by)
+            global_step += 1
+            if global_step % tau == 0:       # mnist-ea.lua:110 cadence
+                ets = ea_round(ets)
+            if global_step % opt.reportEvery == 0:
+                cm = reduce_confusion(ets.cm)
+                log(f"step {global_step} loss "
+                    f"{float(np.mean(np.asarray(losses))):.4f} "
+                    f"{M.format_confusion(cm)}")
+        # end-of-epoch synchronizeCenter (mnist-ea.lua:121): broadcast node
+        # 0's center replica — deterministic psums keep replicas identical,
+        # this is the multi-host drift repair (lua/AllReduceEA.lua:74-84)
+        ets = ets._replace(
+            center=tree.scatter(ets.center, src=0),
+            cm=jax.tree_util.tree_map(lambda c: c * 0, ets.cm))
+        log(f"epoch {epoch}: ({timer.steps_per_sec():.1f} steps/s)")
+    jax.block_until_ready(ets.params)
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+// distcomm — native transport core for distlearn_tpu.comm.
+//
+// The reference framework's communication backend is torch-ipc, a C++
+// library doing all socket IO and tree reductions under Lua bindings
+// (SURVEY.md §2b).  This is its TPU-framework counterpart: the byte-moving
+// hot path (frame assembly, full-buffer send/recv loops, and the host-side
+// in-memory tree reduction used by the DCN control plane) in C++, loaded
+// from Python via ctypes (no pybind11 in this environment).
+//
+// Wire protocol (must match distlearn_tpu/comm/transport.py):
+//   frame := kind:u8 | length:u64le | payload[length]
+//
+// All functions return 0 on success, -1 on peer-closed, or -errno.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+// Full-write loop over writev: header + payload in one syscall when possible.
+int write_all(int fd, iovec *iov, int iovcnt) {
+  while (iovcnt > 0) {
+    ssize_t n = ::writev(fd, iov, iovcnt);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    size_t left = static_cast<size_t>(n);
+    while (iovcnt > 0 && left >= iov->iov_len) {
+      left -= iov->iov_len;
+      ++iov;
+      --iovcnt;
+    }
+    if (iovcnt > 0) {
+      iov->iov_base = static_cast<uint8_t *>(iov->iov_base) + left;
+      iov->iov_len -= left;
+    }
+  }
+  return 0;
+}
+
+} // namespace
+
+extern "C" {
+
+int dc_send_frame(int fd, uint8_t kind, const uint8_t *payload, uint64_t len) {
+  uint8_t header[9];
+  header[0] = kind;
+  std::memcpy(header + 1, &len, 8); // little-endian hosts only (x86/ARM LE)
+  iovec iov[2] = {{header, sizeof(header)},
+                  {const_cast<uint8_t *>(payload), static_cast<size_t>(len)}};
+  return write_all(fd, iov, len ? 2 : 1);
+}
+
+// Two-part frame (tensor path): header(9) + meta + raw tensor bytes in one
+// writev — lets Python pass the numpy buffer pointer zero-copy.
+int dc_send_frame2(int fd, uint8_t kind, const uint8_t *meta, uint64_t mlen,
+                   const uint8_t *data, uint64_t dlen) {
+  uint8_t header[9];
+  header[0] = kind;
+  uint64_t total = mlen + dlen;
+  std::memcpy(header + 1, &total, 8);
+  iovec iov[3] = {{header, sizeof(header)},
+                  {const_cast<uint8_t *>(meta), static_cast<size_t>(mlen)},
+                  {const_cast<uint8_t *>(data), static_cast<size_t>(dlen)}};
+  return write_all(fd, iov, dlen ? 3 : (mlen ? 2 : 1));
+}
+
+int dc_recv_exact(int fd, uint8_t *buf, uint64_t len) {
+  uint64_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd, buf + got, len - got, 0);
+    if (n == 0) return -1; // peer closed
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    got += static_cast<uint64_t>(n);
+  }
+  return 0;
+}
+
+// In-place elementwise reduction kernels for the host-side tree reduce
+// (the reference runs user Lua closures per tensor pair; here: fixed
+// native kernels selected by op code — 0=sum, 1=max, 2=min).
+#define DC_REDUCE_IMPL(T)                                                      \
+  void dc_reduce_##T(T *dst, const T *src, uint64_t n, int op) {               \
+    switch (op) {                                                              \
+    case 0:                                                                    \
+      for (uint64_t i = 0; i < n; ++i) dst[i] += src[i];                       \
+      break;                                                                   \
+    case 1:                                                                    \
+      for (uint64_t i = 0; i < n; ++i) dst[i] = dst[i] > src[i] ? dst[i] : src[i]; \
+      break;                                                                   \
+    case 2:                                                                    \
+      for (uint64_t i = 0; i < n; ++i) dst[i] = dst[i] < src[i] ? dst[i] : src[i]; \
+      break;                                                                   \
+    }                                                                          \
+  }
+
+DC_REDUCE_IMPL(float)
+DC_REDUCE_IMPL(double)
+DC_REDUCE_IMPL(int32_t)
+DC_REDUCE_IMPL(int64_t)
+
+} // extern "C"

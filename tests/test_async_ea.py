@@ -1,0 +1,164 @@
+"""AsyncEA protocol tests.
+
+The reference has NO tests for its async path (SURVEY.md §4: "no tests for
+AsyncEA at all"); these cover the protocol over the real transport on
+localhost — threads as processes, like the reference's own ``ipc.map``
+threading trick for the sync suites (test/test_AllReduceSGD.lua:26-35).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
+                                             AsyncEATester)
+from distlearn_tpu.utils.logging import set_verbose
+
+set_verbose(False)
+
+_PORT = [21000]
+
+
+def _ports(n: int = 40) -> int:
+    """Hand out a fresh base-port window per test (server occupies
+    port..port+numNodes+1)."""
+    p = _PORT[0]
+    _PORT[0] += n
+    return p
+
+
+def _params():
+    return {"w": np.zeros((4, 3), np.float32), "b": np.zeros((3,), np.float32)}
+
+
+def test_init_broadcast_delivers_center():
+    port = _ports()
+    server_params = {"w": np.full((4, 3), 7.0, np.float32),
+                     "b": np.full((3,), -1.0, np.float32)}
+    got = {}
+
+    def client_fn(node):
+        c = AsyncEAClient("127.0.0.1", port, node=node, tau=10, alpha=0.5)
+        got[node] = c.init_client(_params())
+        c.close()
+
+    threads = [threading.Thread(target=client_fn, args=(i,)) for i in (1, 2)]
+    for t in threads:
+        t.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=2)
+    srv.init_server(server_params)
+    for t in threads:
+        t.join(timeout=30)
+    srv.close()
+    for node in (1, 2):
+        np.testing.assert_array_equal(got[node]["w"], server_params["w"])
+        np.testing.assert_array_equal(got[node]["b"], server_params["b"])
+
+
+def test_sync_round_easgd_math():
+    """One client, one sync: delta=(p-c)*alpha, p-=delta, center+=delta
+    (lua/AsyncEA.lua:109-119,212-216)."""
+    port = _ports()
+    alpha = 0.5
+    out = {}
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=2, alpha=alpha)
+        p = c.init_client(_params())
+        p = {"w": p["w"] + 2.0, "b": p["b"] + 4.0}  # local training drift
+        p, synced = c.sync_client(p)      # step 1: no sync
+        assert not synced
+        p, synced = c.sync_client(p)      # step 2: tau boundary -> sync
+        assert synced
+        out["p"] = p
+        c.close()
+
+    th = threading.Thread(target=client_fn)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1)
+    srv.init_server(_params())            # center = zeros
+    new_params = srv.sync_server(_params())
+    th.join(timeout=30)
+    srv.close()
+    # delta_w = (2 - 0) * 0.5 = 1 -> client w: 2-1=1; center_w: 0+1=1
+    # delta_b = (4 - 0) * 0.5 = 2 -> client b: 4-2=2; center_b: 0+2=2
+    np.testing.assert_allclose(out["p"]["w"], 1.0)
+    np.testing.assert_allclose(out["p"]["b"], 2.0)
+    np.testing.assert_allclose(new_params["w"], 1.0)  # params := center
+    np.testing.assert_allclose(new_params["b"], 2.0)
+
+
+def test_concurrent_clients_serialized_and_consistent():
+    """Two clients hammer the server concurrently; the Enter?/Enter critical
+    section must serialize them (lua :163-177) and every delta must land on
+    the center exactly once."""
+    port = _ports()
+    alpha, tau, rounds = 0.5, 1, 8
+    rng = np.random.RandomState(0)
+    drifts = {1: rng.randn(rounds).astype(np.float32),
+              2: rng.randn(rounds).astype(np.float32)}
+    sent_deltas = []
+    lock = threading.Lock()
+
+    def client_fn(node):
+        c = AsyncEAClient("127.0.0.1", port, node=node, tau=tau, alpha=alpha)
+        p = c.init_client({"w": np.zeros((2, 2), np.float32)})
+        for r in range(rounds):
+            p = {"w": p["w"] + drifts[node][r]}
+            before = p["w"].copy()
+            p, synced = c.sync_client(p)
+            assert synced
+            with lock:
+                sent_deltas.append(before - p["w"])  # = delta sent
+        c.close()
+
+    threads = [threading.Thread(target=client_fn, args=(i,)) for i in (1, 2)]
+    for t in threads:
+        t.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=2)
+    srv.init_server({"w": np.zeros((2, 2), np.float32)})
+    for _ in range(2 * rounds):
+        srv.sync_server({"w": np.zeros((2, 2), np.float32)})
+    for t in threads:
+        t.join(timeout=60)
+    # center must equal the sum of every delta the clients applied locally
+    total = np.sum(sent_deltas, axis=0)
+    np.testing.assert_allclose(srv.center[0], total, rtol=1e-5, atol=1e-5)
+    srv.close()
+
+
+def test_tester_receives_center_push():
+    port = _ports()
+    out = {}
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        p = c.init_client(_params())
+        p, _ = c.sync_client({"w": p["w"] + 1.0, "b": p["b"]})
+        c.close()
+
+    def tester_fn():
+        t = AsyncEATester("127.0.0.1", port, num_nodes=1)
+        p = t.start_test(_params())
+        out["center"] = p
+        t.finish_test()
+        t.close()
+
+    tc = threading.Thread(target=client_fn)
+    tt = threading.Thread(target=tester_fn)
+    tc.start()
+    tt.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1, with_tester=True)
+    srv.init_server(_params())
+    srv.sync_server(_params())
+    srv.test_net()
+    tc.join(timeout=30)
+    tt.join(timeout=30)
+    srv.close()
+    np.testing.assert_allclose(out["center"]["w"], 0.5)  # (1-0)*0.5 applied
+
+
+def test_client_requires_one_based_node():
+    with pytest.raises(ValueError):
+        AsyncEAClient("127.0.0.1", _ports(), node=0, tau=1, alpha=0.5)
