@@ -1,0 +1,262 @@
+"""Base-b TCP tree collectives — the torch-ipc ``ipc.Tree`` /
+``ipc.LocalhostTree`` rebuild (reference construction sites:
+examples/mnist.lua:16, examples/client_remote.lua:41; claimed cost
+``T*log_b(N)`` — lua/AllReduceEA.md:26-30).
+
+Role in the TPU framework: the **DCN/host side-channel**.  On-chip
+collectives go through XLA/ICI (distlearn_tpu.parallel.mesh); this tree
+carries host-side traffic that must cross processes or hosts outside a jitted
+program — multi-host bootstrap, control-plane reductions, metric aggregation
+for processes not sharing a mesh.  The byte-moving and reduction inner loops
+run in native C++ (distcomm framing + elementwise kernels).
+
+Topology: complete base-``b`` tree over 0-based ranks in level order —
+``parent(i) = (i-1)//b``, ``children(i) = i*b+1 .. i*b+b``.  Bootstrap: every
+rank registers with rank 0, receives its parent's address, then connects to
+its parent (so data flows parent↔child directly, never relayed through the
+root).
+
+API parity with the reference ``tree`` handle: ``all_reduce`` (+ contributor
+count and zero-contribution flush semantics — lua/AllReduceSGD.lua:12,37),
+``scatter`` (root broadcast), ``walk`` (walkTable), ``node_index``,
+``num_nodes``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+try:  # pytree walking without importing all of jax at module import
+    import jax.tree_util as _jtu
+except Exception:  # pragma: no cover
+    _jtu = None
+
+from distlearn_tpu.comm import native
+from distlearn_tpu.comm.transport import Conn, Server, connect
+
+PyTree = Any
+
+
+def _identity(dtype: np.dtype, op: str):
+    """Reduction identity for a non-contributing rank's slot."""
+    if op == "sum":
+        return 0
+    if op == "max":
+        return -np.inf if np.issubdtype(dtype, np.floating) \
+            else np.iinfo(dtype).min
+    if op == "min":
+        return np.inf if np.issubdtype(dtype, np.floating) \
+            else np.iinfo(dtype).max
+    raise ValueError(f"unknown op {op!r}")
+
+
+def _parent(rank: int, base: int) -> int:
+    return (rank - 1) // base
+
+
+def _children(rank: int, base: int, n: int) -> list[int]:
+    return [c for c in range(rank * base + 1, rank * base + base + 1)
+            if c < n]
+
+
+class Tree:
+    """One rank's handle on the tree (construct one per process/thread).
+
+    ``rank`` is 0-based (the reference's nodeIndex is 1-based; the examples
+    translate).  Rank 0 is the root and must be constructed with the
+    coordinator address it listens on; other ranks connect to it.
+    """
+
+    def __init__(self, rank: int, num_nodes: int, host: str, port: int,
+                 base: int = 2, timeout: float = 60.0,
+                 listen_host: str | None = None,
+                 advertise_host: str | None = None):
+        """``host``/``port``: the coordinator (rank 0) address every rank
+        dials for bootstrap.  Multi-host ranks must also say where THEY can
+        be reached: ``listen_host`` is the local bind address for this rank's
+        child-listener (default: ``host``, correct only when all ranks share
+        it, e.g. localhost; use ``"0.0.0.0"`` on a multi-host deployment) and
+        ``advertise_host`` is the address other ranks should dial to reach
+        this rank (default: ``listen_host`` if set and routable, else
+        ``host``)."""
+        if not 0 <= rank < num_nodes:
+            raise ValueError(f"rank {rank} out of range for {num_nodes} nodes")
+        if base < 1:
+            raise ValueError("base must be >= 1")
+        self.rank = rank
+        self.num_nodes = num_nodes
+        self.base = base
+        self._kids: list[Conn] = []
+        self._parent: Conn | None = None
+        kid_ranks = _children(rank, base, num_nodes)
+
+        bind_host = listen_host if listen_host is not None else host
+        adv_host = advertise_host if advertise_host is not None else (
+            listen_host if listen_host not in (None, "0.0.0.0", "::") else host)
+
+        # Every rank (incl. root) listens for its children first.
+        self._kid_server = Server(bind_host, 0) if kid_ranks else None
+
+        if rank == 0:
+            if num_nodes > 1:
+                coord = Server(bind_host, port)
+                regs: dict[int, Conn] = {}
+                for _ in range(num_nodes - 1):
+                    c = coord.accept(1, timeout=timeout)[0]
+                    msg = c.recv_msg()
+                    regs[int(msg["rank"])] = c
+                # Tell each rank its parent's address.
+                addrs = {0: (adv_host, self._kid_server.port)}
+                # collect every rank's child-listener address
+                for r, c in regs.items():
+                    addrs[r] = tuple(regs[r].recv_msg()["listen"])
+                for r, c in regs.items():
+                    p = _parent(r, base)
+                    c.send_msg({"parent": list(addrs[p])})
+                for c in regs.values():
+                    c.close()
+                coord.close()
+        else:
+            c = connect(host, port, retries=int(timeout * 4))
+            c.send_msg({"rank": rank})
+            listen = (adv_host, self._kid_server.port) if self._kid_server \
+                else (adv_host, 0)
+            c.send_msg({"listen": list(listen)})
+            p_host, p_port = c.recv_msg()["parent"]
+            self._parent = connect(p_host, int(p_port), retries=int(timeout * 4))
+            self._parent.send_msg({"child": rank})
+            c.close()
+
+        # Accept child connections in child-rank order.
+        if self._kid_server is not None:
+            by_rank: dict[int, Conn] = {}
+            for _ in kid_ranks:
+                conn = self._kid_server.accept(1, timeout=timeout)[0]
+                hello = conn.recv_msg()
+                by_rank[int(hello["child"])] = conn
+            self._kids = [by_rank[r] for r in sorted(by_rank)]
+
+    # -- walkTable parity ----------------------------------------------------
+    @staticmethod
+    def walk(tree: PyTree, fn: Callable) -> PyTree:
+        return _jtu.tree_map(fn, tree)
+
+    @property
+    def node_index(self) -> int:
+        return self.rank
+
+    # -- collectives ---------------------------------------------------------
+    def all_reduce(self, value: PyTree, op: str = "sum",
+                   contrib: bool = True) -> tuple[PyTree, int]:
+        """Tree allreduce; returns ``(reduced, n_contributors)``.
+
+        ``contrib=False`` reproduces the reference's zero-contribution flush
+        (lua/AllReduceSGD.lua:37): this rank's values count as zeros and it
+        is excluded from ``n`` — but it still serves the reduction for the
+        rest of the tree, which is exactly how stopped nodes keep stragglers'
+        reductions alive in the reference.
+        """
+        reduced, n, _ = self.all_reduce_ex(value, op=op, contrib=contrib)
+        return reduced, n
+
+    def all_reduce_ex(self, value: PyTree, op: str = "sum",
+                      contrib: bool = True, rider: int = 0
+                      ) -> tuple[PyTree, int, int]:
+        """:meth:`all_reduce` plus an out-of-band integer ``rider`` summed
+        across ALL ranks regardless of ``contrib`` — carries round metadata
+        (e.g. how many participants are in flush mode, the uneven-step
+        protocol of distlearn_tpu.parallel.host_algorithms)."""
+        leaves = [np.ascontiguousarray(np.asarray(x))
+                  for x in _jtu.tree_leaves(value)]
+        if not contrib:
+            acc = [np.full_like(x, _identity(x.dtype, op)) for x in leaves]
+        else:
+            acc = [x.copy() for x in leaves]
+        n = 1 if contrib else 0
+        r = int(rider)
+        # Up phase: fold children into acc.
+        for kid in self._kids:
+            hdr = kid.recv_msg()
+            n += int(hdr["n"])
+            r += int(hdr["r"])
+            for a in acc:
+                part = kid.recv_tensor()
+                native.reduce_inplace(a, part.astype(a.dtype, copy=False), op)
+        # Send to parent; receive final result down.
+        if self._parent is not None:
+            self._parent.send_msg({"n": n, "r": r})
+            for a in acc:
+                self._parent.send_tensor(a)
+            down = self._parent.recv_msg()
+            total, r_total = int(down["n"]), int(down["r"])
+            final = [self._parent.recv_tensor(out=a) for a in acc]
+        else:
+            total, r_total, final = n, r, acc
+        # Down phase: forward result to children.
+        for kid in self._kids:
+            kid.send_msg({"n": total, "r": r_total})
+            for a in final:
+                kid.send_tensor(a)
+        treedef = _jtu.tree_structure(value)
+        return _jtu.tree_unflatten(treedef, final), total, r_total
+
+    def scatter(self, value: PyTree) -> PyTree:
+        """Root's values broadcast to every rank (ref ``tree.scatter``,
+        lua/AllReduceSGD.lua:52)."""
+        leaves = [np.ascontiguousarray(np.asarray(x))
+                  for x in _jtu.tree_leaves(value)]
+        if self._parent is not None:
+            leaves = [self._parent.recv_tensor(out=a) for a in leaves]
+        for kid in self._kids:
+            for a in leaves:
+                kid.send_tensor(a)
+        treedef = _jtu.tree_structure(value)
+        return _jtu.tree_unflatten(treedef, leaves)
+
+    def barrier(self):
+        """All ranks rendezvous (reduce of a scalar)."""
+        self.all_reduce(np.zeros((), np.int32))
+
+    def close(self):
+        if self._parent:
+            self._parent.close()
+        for k in self._kids:
+            k.close()
+        if self._kid_server:
+            self._kid_server.close()
+
+
+def LocalhostTree(rank: int, num_nodes: int, port: int, base: int = 2) -> Tree:
+    """Single-host convenience (ref ``ipc.LocalhostTree(nodeIndex, numNodes)``,
+    examples/mnist.lua:16).  All ranks must pass the same ``port``."""
+    return Tree(rank, num_nodes, "127.0.0.1", port, base=base)
+
+
+def tree_map_spawn(fn: Callable, n: int, *args, timeout: float = 120.0
+                   ) -> list:
+    """``ipc.map(n, fn, args...)`` parity (test/test_AllReduceSGD.lua:27):
+    run ``fn(rank, *args)`` on ``n`` Python threads, join, return results
+    in rank order.  (Threads, like the reference's fresh-Lua-state threads,
+    share the process; the transport is real localhost TCP either way.)"""
+    results: list = [None] * n
+    errors: list = []
+
+    def _run(i):
+        try:
+            results[i] = fn(i, *args)
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=_run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    if errors:
+        raise errors[0][1]
+    return results

@@ -1,0 +1,175 @@
+"""Host-side AllReduceSGD / AllReduceEA over the TCP tree backend.
+
+These are the literal reference semantics (lua/AllReduceSGD.lua,
+lua/AllReduceEA.lua) for deployments where nodes are OS processes/hosts on
+DCN rather than devices on an ICI mesh — the process-per-node shape of the
+original framework (examples/mnist.sh spawning N ``th`` processes).
+On-mesh training should use the fused builders in distlearn_tpu.train; these
+adapters exist for (a) parity with the reference's multi-process mode,
+(b) the multi-host control plane, and (c) running the reference's own
+randomized invariant tests against the tree backend
+(test/test_AllReduceSGD.lua, test/test_AllReduceEA.lua).
+
+**Uneven-step protocol.**  Tree reductions are blocking and pair by ordinal:
+node A's k-th allreduce completes against every other node's k-th allreduce.
+Nodes run different step counts per epoch, so a node that finished early must
+keep *serving* stragglers' rounds from inside its sync call — the reference
+does this with torch-ipc's flush mode (``tree.allReduce(nil, add, zeroFn)``,
+lua/AllReduceSGD.lua:37; inline EA callback, lua/AllReduceEA.lua:58-68).
+Here every round carries a ``flush`` rider counting how many participants are
+in their sync call; the sync loop serves rounds (zero-contribution for SGD,
+real elastic contributions for EA — matching the reference's two flush
+flavors) until a round reports all nodes flushing, which is the terminal
+round.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+try:
+    import jax.tree_util as _jtu
+except Exception:  # pragma: no cover
+    _jtu = None
+
+from distlearn_tpu.comm.tree import Tree
+
+PyTree = Any
+
+
+class TreeAllReduceSGD:
+    """Reference lua/AllReduceSGD.lua over a TCP tree (API: sumGradients /
+    sumAndNormalizeGradients / synchronizeParameters, lua :56-60)."""
+
+    def __init__(self, tree: Tree):
+        self.tree = tree
+        self.my_steps = 0   # own slot of stepsPerNode (ref lua :7)
+
+    def _round(self, grads: PyTree, contrib: bool, flushing: bool
+               ) -> tuple[PyTree, int, int]:
+        """One ordinal-paired reduction round: gradient sum + contributor
+        count + flush count (rider is summed across all ranks regardless of
+        ``contrib``)."""
+        summed, n, n_flush = self.tree.all_reduce_ex(
+            grads, contrib=contrib, rider=1 if flushing else 0)
+        return summed, n, n_flush
+
+    def sum_gradients(self, grads: PyTree, contrib: bool = True
+                      ) -> tuple[PyTree, int]:
+        """Ref lua :10-15: allreduce-sum grads, bump own step count."""
+        out, n, _ = self._round(grads, contrib, flushing=False)
+        if contrib:
+            self.my_steps += 1
+        return out, n
+
+    def sum_and_normalize_gradients(self, grads: PyTree, contrib: bool = True
+                                    ) -> tuple[PyTree, int]:
+        """Ref lua :18-30: sum then scale by 1/n contributors."""
+        out, n = self.sum_gradients(grads, contrib)
+        if n > 1:
+            out = _jtu.tree_map(lambda g: g / np.asarray(n, g.dtype), out)
+        return out, n
+
+    def synchronize_parameters(self, params: PyTree) -> PyTree:
+        """Ref lua :33-54.  Serve stragglers' gradient rounds with zero
+        contributions (the ``zeroFn`` flush, lua :37) until every node is
+        here; then allreduce the step counts, winner = max steps (ties →
+        highest rank, matching ``stepsPerNode:sort()`` taking the last
+        element, lua :41); non-winners zero their params; one final allreduce
+        leaves the winner's params everywhere — bitwise (the reference's own
+        oracle, test/test_AllReduceSGD.lua:38).  If NO node stepped: scatter
+        from root (lua :52)."""
+        zeros = _jtu.tree_map(np.zeros_like, params)
+        while True:
+            _, _, n_flush = self._round(zeros, contrib=False, flushing=True)
+            if n_flush == self.tree.num_nodes:
+                break
+        steps_vec = np.zeros(self.tree.num_nodes, np.int64)
+        steps_vec[self.tree.rank] = self.my_steps
+        all_steps, _ = self.tree.all_reduce(steps_vec)
+        if int(all_steps.max()) > 0:
+            rev = all_steps[::-1]
+            winner = len(all_steps) - 1 - int(np.argmax(rev))
+            mine = params if self.tree.rank == winner else zeros
+            synced, _ = self.tree.all_reduce(mine)
+        else:
+            synced = self.tree.scatter(params)
+        self.my_steps = 0
+        return synced
+
+
+class TreeAllReduceEA:
+    """Reference lua/AllReduceEA.lua over a TCP tree (API: averageParameters /
+    synchronizeCenter / synchronizeParameters, lua :102-106)."""
+
+    def __init__(self, tree: Tree, tau: int, alpha: float):
+        self.tree = tree
+        self.tau = int(tau)
+        self.alpha = float(alpha)
+        self.step = 0
+        self.center: PyTree | None = None
+
+    def _one_time_init(self, params: PyTree):
+        """Ref lua :11-22: lazily clone params as the center replica."""
+        if self.center is None:
+            self.center = _jtu.tree_map(
+                lambda p: np.array(p, dtype=np.asarray(p).dtype, copy=True),
+                params)
+
+    def _round(self, params: PyTree, flushing: bool) -> tuple[PyTree, int]:
+        """One elastic round (ref lua :35-45): delta=(p-c)*alpha, p-=delta,
+        allreduce deltas, center+=Σdelta.  Flush rounds contribute REAL
+        deltas (the reference's inline callback, lua :58-68)."""
+        delta = _jtu.tree_map(
+            lambda p, c: (np.asarray(p) - c)
+            * np.asarray(self.alpha, np.asarray(p).dtype),
+            params, self.center)
+        new_params = _jtu.tree_map(lambda p, d: np.asarray(p) - d,
+                                   params, delta)
+        summed, _, n_flush = self.tree.all_reduce_ex(
+            delta, rider=1 if flushing else 0)
+        self.center = _jtu.tree_map(lambda c, d: c + d, self.center, summed)
+        return new_params, n_flush
+
+    def average_parameters(self, params: PyTree) -> PyTree:
+        """Ref lua :25-47: every tau-th local step runs one elastic round;
+        other steps are communication-free (lua :31)."""
+        self._one_time_init(params)
+        self.step += 1
+        if self.step % self.tau != 0:
+            return params
+        new_params, _ = self._round(params, flushing=False)
+        return new_params
+
+    def _drain(self, params: PyTree) -> PyTree:
+        """Serve stragglers' rounds with real elastic contributions until all
+        nodes are draining (ref handleUnevenSteps, lua :50-72)."""
+        self._one_time_init(params)
+        while True:
+            params, n_flush = self._round(params, flushing=True)
+            if n_flush == self.tree.num_nodes:
+                return params
+
+    def synchronize_center(self, params: PyTree) -> PyTree:
+        """Ref lua :77-84: drain uneven rounds, then scatter the root's
+        center (fp-drift repair), reset the step counter."""
+        params = self._drain(params)
+        self.center = self.tree.scatter(self.center)
+        self.step = 0
+        return params
+
+    def synchronize_parameters(self, params: PyTree) -> PyTree:
+        """Ref lua :87-100: drain, scatter params from root, center :=
+        params."""
+        if self.center is not None:
+            params = self._drain(params)
+        else:
+            self._one_time_init(params)
+        params = self.tree.scatter(params)
+        self.center = _jtu.tree_map(
+            lambda p: np.array(p, dtype=np.asarray(p).dtype, copy=True),
+            params)
+        self.step = 0
+        return params

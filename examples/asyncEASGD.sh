@@ -13,7 +13,8 @@ MODEL=${MODEL:-mnist}
 TAU=${TAU:-4}
 # steps/epoch = (N/NODES)/BATCH; syncs = NODES*EPOCHS*(steps/tau)
 STEPS_PER_EPOCH=$(( (N / NODES) / BATCH ))
-SYNCS=$(( NODES * EPOCHS * (STEPS_PER_EPOCH / TAU) ))
+# client sync counters run continuously across epochs
+SYNCS=$(( NODES * ((EPOCHS * STEPS_PER_EPOCH) / TAU) ))
 TESTTIME=${TESTTIME:-4}
 NUMTESTS=$(( SYNCS / TESTTIME + 1 ))
 

@@ -31,13 +31,15 @@ def main():
     set_verbose(True)  # server logs are the reference's printServer
     model, params, mstate, ds, nc = build_model_and_data(opt)
 
-    # Each client trains on a 1/numNodes partition and syncs every tau of its
-    # own continuously-counted steps, so the server must expect exactly
-    # numNodes * (total_client_steps // tau) handshakes.
-    per_client_steps = (ds.size // opt.numNodes) // max(1, opt.batchSize)
-    num_syncs = opt.numSyncs or (
-        opt.numNodes * ((opt.numEpochs * per_client_steps)
-                        // opt.communicationTime))
+    # Each client trains on its own partition (last partition takes the
+    # remainder rows — data.make_dataset) and syncs every tau of its
+    # continuously-counted steps, so the server must expect exactly
+    # sum_i (numEpochs * steps_i) // tau handshakes.
+    per = ds.size // opt.numNodes
+    sizes = [per] * (opt.numNodes - 1) + [ds.size - per * (opt.numNodes - 1)]
+    num_syncs = opt.numSyncs or sum(
+        (opt.numEpochs * (sz // max(1, opt.batchSize)))
+        // opt.communicationTime for sz in sizes)
     print_server(f"serving {opt.numNodes} clients, {num_syncs} syncs, "
                  f"tester={opt.tester}")
 

@@ -1,0 +1,147 @@
+"""TCP tree backend tests — the reference's own randomized multi-node
+invariant suites (test/test_AllReduceSGD.lua, test/test_AllReduceEA.lua)
+re-run against the host-side tree, plus transport-level collective checks.
+Threads connected over real localhost TCP stand in for processes, exactly
+like the reference's ``ipc.map`` fixture (test/test_AllReduceSGD.lua:26-35).
+"""
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.comm.tree import LocalhostTree, tree_map_spawn
+from distlearn_tpu.parallel.host_algorithms import (TreeAllReduceEA,
+                                                    TreeAllReduceSGD)
+
+_PORT = [27000]
+
+
+def _port() -> int:
+    _PORT[0] += 7
+    return _PORT[0]
+
+
+@pytest.mark.parametrize("n,base", [(2, 2), (4, 2), (8, 2), (5, 3), (8, 4)])
+def test_allreduce_sum_and_scatter(n, base):
+    port = _port()
+    rng = np.random.RandomState(0)
+    values = [rng.randn(3, 4).astype(np.float32) for _ in range(n)]
+
+    def node(rank):
+        t = LocalhostTree(rank, n, port, base=base)
+        red, m = t.all_reduce({"v": values[rank]})
+        sc = t.scatter({"v": np.full((2, 2), float(rank), np.float32)})
+        t.close()
+        return red["v"], m, sc["v"]
+
+    results = tree_map_spawn(node, n)
+    expected = np.sum(values, axis=0)
+    for red, m, sc in results:
+        np.testing.assert_allclose(red, expected, rtol=1e-5)
+        assert m == n
+        np.testing.assert_array_equal(sc, 0.0)  # root's value everywhere
+
+
+def test_allreduce_max_with_flush_identity():
+    """op='max' with a non-contributor: the flushing rank's slot must be the
+    op identity (-inf), not zero — all-negative values survive."""
+    n, port = 3, _port()
+
+    def node(rank):
+        t = LocalhostTree(rank, n, port)
+        red, m = t.all_reduce(np.array([-5.0 - rank]), op="max",
+                              contrib=(rank != 1))
+        t.close()
+        return red, m
+
+    for red, m in tree_map_spawn(node, n):
+        np.testing.assert_array_equal(red, -5.0)
+        assert m == 2
+
+
+def test_allreduce_zero_contribution_flush():
+    n, port = 4, _port()
+
+    def node(rank):
+        t = LocalhostTree(rank, n, port)
+        contrib = rank < 2   # ranks 2,3 flush (ref lua/AllReduceSGD.lua:37)
+        red, m = t.all_reduce(np.ones(5, np.float64), contrib=contrib)
+        t.close()
+        return red, m
+
+    for red, m in tree_map_spawn(node, n):
+        np.testing.assert_array_equal(red, 2.0)
+        assert m == 2
+
+
+def test_tree_sgd_reference_invariant():
+    """Port of test/test_AllReduceSGD.lua: each node runs its OWN random
+    4-13 steps per epoch (uneven — stragglers are served by the flush
+    protocol inside synchronizeParameters), then after sync all nodes'
+    params are BITWISE identical (the reference oracle, lua :38)."""
+    rng = np.random.RandomState(7)
+    for trial in range(3):
+        n = int(rng.choice([2, 4, 8]))
+        port = _port()
+
+        def node(rank):
+            t = LocalhostTree(rank, n, port)
+            sgd = TreeAllReduceSGD(t)
+            r = np.random.RandomState(100 * trial + rank)
+            params = {"w": np.zeros((4, 3), np.float64)}
+            for ep in range(3):
+                for _ in range(int(r.randint(4, 14))):  # own count only
+                    g, m = sgd.sum_and_normalize_gradients(
+                        {"w": r.randn(4, 3)})
+                    params = {"w": params["w"] - 0.01 * g["w"]}
+                params = sgd.synchronize_parameters(params)
+            t.close()
+            return params["w"]
+
+        results = tree_map_spawn(node, n)
+        for w in results[1:]:
+            np.testing.assert_array_equal(results[0], w)  # bitwise oracle
+
+
+def test_tree_ea_reference_invariant():
+    """Port of test/test_AllReduceEA.lua: tau=3 alpha=0.4, each node walks
+    randn/slowit with slowit doubling per step (noise -> 0 geometrically),
+    own random 45-53 steps per epoch, synchronizeCenter at each epoch end;
+    final inter-node params gap < 1e-6 (the reference oracle, lua :38-39)."""
+    rng = np.random.RandomState(3)
+    n = int(rng.choice([2, 4, 8]))
+    port = _port()
+    tau, alpha, epochs = 3, 0.4, 3
+
+    def node(rank):
+        t = LocalhostTree(rank, n, port)
+        ea = TreeAllReduceEA(t, tau, alpha)
+        r = np.random.RandomState(200 + rank)
+        params = {"w": r.randn(7)}
+        params = ea.synchronize_parameters(params)
+        slowit = 1.0
+        for ep in range(epochs):
+            for _ in range(int(r.randint(45, 54))):  # own count only
+                params = {"w": params["w"] + r.randn(7) / slowit}
+                slowit *= 2.0
+                params = ea.average_parameters(params)
+            params = ea.synchronize_center(params)
+        t.close()
+        return params["w"]
+
+    results = tree_map_spawn(node, n)
+    params = np.stack(results)
+    gap = np.abs(params - params[0]).max()
+    assert gap < 1e-6, gap
+
+
+def test_barrier_and_ranks():
+    n, port = 4, _port()
+
+    def node(rank):
+        t = LocalhostTree(rank, n, port)
+        t.barrier()
+        idx = t.node_index
+        t.close()
+        return idx
+
+    assert tree_map_spawn(node, n) == [0, 1, 2, 3]
