@@ -1,0 +1,190 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+Not in the reference (CNN classifiers only — SURVEY.md §2c), but first-class
+here: the attention runs as ring attention over a sequence mesh axis
+(distlearn_tpu.parallel.sequence) and the MLP/attention projections support
+tensor parallelism over a model mesh axis, so one model spans
+(data, seq, model) meshes.
+
+Sharding convention (inside ``shard_map``): ``apply`` receives LOCAL param
+shards.  With ``tp_axis`` set, the caller shards
+
+* ``wq/wk/wv``:   [E, H, D]  → heads split over tp   (spec P(None, tp))
+* ``wo``:         [H, D, E]  → heads split over tp   (spec P(tp))
+* ``mlp/w1,b1``:  [E, F], [F] → F split over tp      (spec P(None, tp) / P(tp))
+* ``mlp/w2``:     [F, E]    → F split over tp        (spec P(tp))
+
+and ``apply`` inserts the one ``psum`` per block that TP requires (after
+``wo`` and ``w2`` — the Megatron pattern: column-parallel then row-parallel).
+:func:`param_specs` produces exactly these PartitionSpecs for a param pytree.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+from jax.sharding import PartitionSpec as P
+
+from distlearn_tpu.models.core import Model
+from distlearn_tpu.parallel.sequence import local_attention, ring_attention
+from distlearn_tpu.parallel.tp import tp_enter, tp_reduce
+
+PyTree = Any
+
+
+def _norm_init(shape, dtype):
+    return {"scale": jnp.ones(shape, dtype)}
+
+
+def _rmsnorm(params, x, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = x * lax.rsqrt(var + eps).astype(x.dtype)
+    return y * params["scale"].astype(x.dtype)
+
+
+def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
+                   heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
+                   dtype=jnp.float32, compute_dtype=None) -> Model:
+    """Returns a :class:`Model` whose ``apply(params, state, tokens, ...)``
+    maps int tokens [B, L_local] -> next-token logits [B, L_local, vocab].
+
+    ``axis_name`` (data axis) is unused here; sequence and tensor axes are
+    passed per-call via ``seq_axis`` / ``tp_axis`` keywords.
+    """
+    head_dim = dim // heads
+    hidden = dim * mlp_ratio
+    cd = compute_dtype or dtype
+
+    def init(key):
+        keys = iter(random.split(key, 4 + depth * 8))
+        scale = 1.0 / math.sqrt(dim)
+        params = {
+            "embed": random.normal(next(keys), (vocab, dim), dtype) * scale,
+            "pos": random.normal(next(keys), (max_len, dim), dtype) * scale,
+            "out_norm": _norm_init((dim,), dtype),
+        }
+        for i in range(depth):
+            params[f"block{i}"] = {
+                "ln1": _norm_init((dim,), dtype),
+                "wq": random.normal(next(keys), (dim, heads, head_dim), dtype) * scale,
+                "wk": random.normal(next(keys), (dim, heads, head_dim), dtype) * scale,
+                "wv": random.normal(next(keys), (dim, heads, head_dim), dtype) * scale,
+                "wo": random.normal(next(keys), (heads, head_dim, dim), dtype) * scale,
+                "ln2": _norm_init((dim,), dtype),
+                "w1": random.normal(next(keys), (dim, hidden), dtype) * scale,
+                "b1": jnp.zeros((hidden,), dtype),
+                "w2": random.normal(next(keys), (hidden, dim), dtype)
+                      * (1.0 / math.sqrt(hidden)),
+                "b2": jnp.zeros((dim,), dtype),
+            }
+        return params, {}
+
+    def apply(params, state, tokens, train=True, rng=None, axis_name=None,
+              bn_weight=None, seq_axis=None, tp_axis=None):
+        B, L = tokens.shape
+        if seq_axis is not None:
+            offset = lax.axis_index(seq_axis) * L
+        else:
+            offset = 0
+        x = params["embed"][tokens].astype(cd)
+        x = x + lax.dynamic_slice_in_dim(params["pos"], offset, L
+                                         ).astype(cd)[None]
+
+        for i in range(depth):
+            blk = params[f"block{i}"]
+            h = _rmsnorm(blk["ln1"], x)
+            if tp_axis is not None:   # enter column-parallel region ("f")
+                h = tp_enter(h, tp_axis)
+            q = jnp.einsum("ble,ehd->blhd", h, blk["wq"].astype(cd))
+            k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
+            v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
+            if seq_axis is not None:
+                att = ring_attention(q, k, v, seq_axis, causal=True)
+            else:
+                att = local_attention(q, k, v, causal=True)
+            proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))
+            if tp_axis is not None:   # heads were sharded: reduce ("g")
+                proj = tp_reduce(proj, tp_axis)
+            x = x + proj
+
+            h = _rmsnorm(blk["ln2"], x)
+            if tp_axis is not None:
+                h = tp_enter(h, tp_axis)
+            h = h @ blk["w1"].astype(cd) + blk["b1"].astype(cd)
+            h = jax.nn.gelu(h)
+            h = h @ blk["w2"].astype(cd)
+            if tp_axis is not None:   # hidden was sharded: reduce ("g")
+                h = tp_reduce(h, tp_axis)
+            x = x + h + blk["b2"].astype(cd)
+
+        x = _rmsnorm(params["out_norm"], x)
+        logits = x @ params["embed"].T.astype(cd)
+        return logits.astype(dtype), state
+
+    return Model(init=init, apply=apply, name="transformer_lm",
+                 input_shape=(max_len,), num_classes=vocab)
+
+
+def param_specs(params: PyTree, tp_axis: str | None) -> PyTree:
+    """PartitionSpecs for shard_map in_specs: TP shards heads / MLP hidden
+    over ``tp_axis``; everything else replicated."""
+    if tp_axis is None:
+        return jax.tree_util.tree_map(lambda _: P(), params)
+
+    def spec_for(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        leafname = names[-1]
+        if leafname in ("wq", "wk", "wv"):
+            return P(None, tp_axis)          # [E, H, D]: split heads
+        if leafname == "wo":
+            return P(tp_axis)                # [H, D, E]: split heads
+        if leafname in ("w1",):
+            return P(None, tp_axis)          # [E, F]: split hidden
+        if leafname in ("b1",):
+            return P(tp_axis)                # [F]
+        if leafname == "w2":
+            return P(tp_axis)                # [F, E]: split hidden
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
+            reduce: bool = True):
+    """Next-token cross-entropy.  With a sequence axis, the final position's
+    target lives on the next shard — the shift rides a ppermute so the loss
+    is exact across shard boundaries.
+
+    ``reduce=False`` returns the LOCAL shard's share of the global-mean loss
+    (local masked sum / global token count) WITHOUT the cross-shard psum —
+    the form to differentiate inside shard_map: ``psum`` transposes to
+    ``psum`` there, so differentiating the psum'd global loss would scale
+    gradients by the seq-axis size; differentiate the local share and psum
+    the resulting partial gradients instead (distlearn_tpu.train.lm)."""
+    logits, _ = model.apply(params, {}, tokens, train=True,
+                            seq_axis=seq_axis, tp_axis=tp_axis)
+    if seq_axis is None:
+        targets = tokens[:, 1:]
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
+        return nll.mean()
+    # first token of the NEXT shard (ring shift by -1)
+    n = lax.psum(1, seq_axis)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    nxt_first = lax.ppermute(tokens[:, :1], seq_axis, perm)  # [B,1]
+    targets = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
+    # the global last position has no target: mask it; normalize by the
+    # GLOBAL token count (a constant — no gradient flows through it)
+    my = lax.axis_index(seq_axis)
+    L = tokens.shape[1]
+    pos = my * L + jnp.arange(L)
+    w = (pos < n * L - 1).astype(jnp.float32)
+    count = lax.psum(jnp.sum(w) * tokens.shape[0], seq_axis)
+    local = jnp.sum(nll * w[None, :]) / jnp.maximum(count, 1.0)
+    return lax.psum(local, seq_axis) if reduce else local

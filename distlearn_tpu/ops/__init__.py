@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the hot ops (fused updates; flat packing)."""
+
+from distlearn_tpu.ops.flatten import FlatSpec, make_spec, pack, unpack
+from distlearn_tpu.ops.fused_update import fused_sgd, fused_elastic
+
+__all__ = ["FlatSpec", "make_spec", "pack", "unpack",
+           "fused_sgd", "fused_elastic"]

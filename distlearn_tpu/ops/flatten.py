@@ -1,0 +1,63 @@
+"""Pytree <-> flat-buffer packing for single-launch fused updates.
+
+The reference applies its SGD/EA updates tensor-by-tensor through walkTable
+(lua/AllReduceSGD.lua:24, lua/AllReduceEA.lua:35-39) — dozens of tiny
+elementwise ops.  On TPU the same math wants to stream the ENTIRE parameter
+set through the VPU once: pack all leaves into one padded flat buffer, run
+one Pallas kernel over it, unpack.  Packing layout is computed once per
+pytree structure (static), so under jit the pack/unpack are pure reshapes and
+concats XLA fuses away.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+LANE = 128
+SUBLANE = 8
+TILE = LANE * SUBLANE  # f32 min tile elements
+
+
+class FlatSpec(NamedTuple):
+    treedef: Any
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[Any, ...]
+    sizes: tuple[int, ...]
+    offsets: tuple[int, ...]
+    padded: int           # total flat length, multiple of TILE
+
+
+def make_spec(tree: PyTree) -> FlatSpec:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
+    total = int(sum(sizes))
+    padded = ((total + TILE - 1) // TILE) * TILE
+    return FlatSpec(treedef, shapes, dtypes, sizes, offsets, padded)
+
+
+def pack(spec: FlatSpec, tree: PyTree, dtype=jnp.float32) -> jax.Array:
+    """Concatenate every leaf (cast to ``dtype``) into one [padded] vector."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = jnp.concatenate(
+        [jnp.ravel(l).astype(dtype) for l in leaves] +
+        ([jnp.zeros(spec.padded - sum(spec.sizes), dtype)]
+         if spec.padded > sum(spec.sizes) else []))
+    return flat
+
+
+def unpack(spec: FlatSpec, flat: jax.Array) -> PyTree:
+    leaves = []
+    for shape, dt, size, off in zip(spec.shapes, spec.dtypes, spec.sizes,
+                                    spec.offsets):
+        leaves.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
+                      .astype(dt).reshape(shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

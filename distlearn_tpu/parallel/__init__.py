@@ -5,6 +5,9 @@ from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
 from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
                                              AsyncEATester)
+from distlearn_tpu.parallel.sequence import ring_attention, local_attention
+from distlearn_tpu.parallel.host_algorithms import (TreeAllReduceSGD,
+                                                    TreeAllReduceEA)
 
 __all__ = [
     "MeshTree",
@@ -16,4 +19,8 @@ __all__ = [
     "AsyncEAServer",
     "AsyncEAClient",
     "AsyncEATester",
+    "ring_attention",
+    "local_attention",
+    "TreeAllReduceSGD",
+    "TreeAllReduceEA",
 ]

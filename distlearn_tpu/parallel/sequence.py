@@ -1,0 +1,111 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+The reference is CNN-only (SURVEY.md §2c: SP/CP explicitly absent), but this
+framework treats long-context as first-class: attention over sequences longer
+than one chip's memory runs blockwise with K/V rotating around the ICI ring
+(Ring Attention; blockwise online-softmax accumulation as in
+FlashAttention), so sequence length scales linearly with the number of chips
+while every hop rides a neighbor ICI link (``lax.ppermute``).
+
+Usage: shard the sequence axis of q/k/v over a mesh axis inside
+``shard_map`` and call :func:`ring_attention` with that axis name.  Each
+device holds ``L_local = L / axis_size`` positions; communication is
+``axis_size - 1`` neighbor exchanges of the local K/V block, fully
+overlappable with the per-block attention compute by XLA's latency-hiding
+scheduler.
+
+All accumulation is f32 regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, scale, mask):
+    """Scores + masked online-softmax partials for one K/V block.
+
+    q: [B, Lq, H, D]; k/v: [B, Lk, H, D]; mask: [Lq, Lk] bool or None.
+    Returns (m_blk [B,H,Lq], s_exp [B,H,Lq,Lk], o_blk [B,H,Lq,D]) partials.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)                      # [B,H,Lq]
+    # guard fully-masked rows: exp(-inf - -inf) -> exp(0) would be wrong,
+    # so replace -inf row-max with 0 (the row's s_exp is all zeros anyway)
+    m_safe = jnp.where(jnp.isneginf(m_blk), 0.0, m_blk)
+    s_exp = jnp.exp(scores - m_safe[..., None])           # [B,H,Lq,Lk]
+    s_exp = jnp.where(jnp.isneginf(scores), 0.0, s_exp)
+    o_blk = jnp.einsum("bhqk,bkhd->bhqd", s_exp,
+                       v.astype(jnp.float32))
+    return m_safe, s_exp.sum(-1), o_blk
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False) -> jax.Array:
+    """Blockwise ring attention.
+
+    Args:
+      q, k, v: local shards ``[B, L_local, H, D]`` — the global sequence is
+        the concatenation over the mesh axis in rank order.
+      axis_name: mesh axis carrying the sequence shards.
+      causal: apply a causal mask over GLOBAL positions.
+
+    Returns: local attention output ``[B, L_local, H, D]`` (q's dtype).
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    qf = q.astype(jnp.float32)
+
+    q_pos = my * Lq + jnp.arange(Lq)                      # global q positions
+
+    def body(i, carry):
+        k_cur, v_cur, m, l, o = carry
+        src = (my - i) % n                                # owner of this block
+        if causal:
+            k_pos = src * Lq + jnp.arange(Lq)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        m_blk, l_blk, o_blk = _block_attn(qf, k_cur.astype(jnp.float32),
+                                          v_cur, scale, mask)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)                        # rescale old acc
+        beta = jnp.exp(m_blk - m_new)
+        l = l * alpha + l_blk * beta
+        o = o * alpha[..., None] + o_blk * beta[..., None]
+        # rotate K/V to the next neighbor (ring step over ICI)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l, o
+
+    m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Lq), jnp.float32)
+    o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
+    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    out = o / jnp.maximum(l, 1e-30)[..., None]            # [B,H,Lq,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False) -> jax.Array:
+    """Single-device reference attention (same layout), for tests and
+    non-sharded runs.  q/k/v: [B, L, H, D]."""
+    B, L, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(L)
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", w, v.astype(jnp.float32))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

@@ -1,0 +1,58 @@
+"""Tensor-parallel region markers — the Megatron f/g pair as custom-VJP
+collectives.
+
+Inside ``shard_map`` without replication tracking (``check_vma=False``),
+``lax.psum`` transposes to ``psum``, which double-counts when the cotangent
+is already replicated across the TP axis.  The correct TP semantics are the
+classic pair:
+
+* :func:`tp_enter` ("f"): identity forward, **psum backward** — placed where
+  a replicated activation enters the column-parallel region, so gradients of
+  upstream replicated params get reduced over the TP axis.
+* :func:`tp_reduce` ("g"): **psum forward**, identity backward — placed after
+  the row-parallel matmul, so TP-sharded weight slices see exactly their own
+  gradient (no tp-fold scaling).
+
+With one f/g pair per TP block the residual stream stays replicated in
+forward AND backward, so replicated-leaf gradients are identical on every TP
+rank and sharded-leaf gradients are exact per slice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_enter(x, axis_name: str):
+    return x
+
+
+def _enter_fwd(x, axis_name):
+    return x, None
+
+
+def _enter_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+tp_enter.defvjp(_enter_fwd, _enter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis_name: str):
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+tp_reduce.defvjp(_reduce_fwd, _reduce_bwd)

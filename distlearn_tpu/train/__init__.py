@@ -6,9 +6,10 @@ from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          build_sgd_step, build_sync_step,
                                          build_eval_step, build_ea_steps,
                                          reduce_confusion)
+from distlearn_tpu.train.lm import build_lm_step
 
 __all__ = [
     "TrainState", "EATrainState", "init_train_state", "init_ea_state",
     "build_sgd_step", "build_sync_step", "build_eval_step", "build_ea_steps",
-    "reduce_confusion",
+    "reduce_confusion", "build_lm_step",
 ]

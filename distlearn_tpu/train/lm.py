@@ -1,0 +1,61 @@
+"""Fused LM train step over a (data, seq, model) mesh — the 3D-parallel
+composition: data parallelism (gradient psum), sequence parallelism (ring
+attention + shifted targets), and tensor parallelism (Megatron-style sharded
+projections) in ONE jitted shard_map program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distlearn_tpu.models.core import Model
+from distlearn_tpu.models.transformer import lm_loss, param_specs
+
+
+def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
+                  data_axis: str = "data", seq_axis: str | None = "seq",
+                  tp_axis: str | None = "model", donate: bool = True
+                  ) -> Callable:
+    """``step(params, tokens) -> (params, loss)``.
+
+    ``tokens``: [global_B, global_L] int32, sharded (data, seq).
+    ``params``: sharded per :func:`param_specs` over ``tp_axis`` (replicated
+    across data/seq).  Gradients are psum'd over data+seq axes (params are
+    replicated there); TP-sharded leaves need no gradient collective — each
+    device owns its slice.
+    """
+    axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
+    pspecs = param_specs(params_template, tp_axis)
+
+    def step(params, tokens):
+        # differentiate the LOCAL loss share (reduce=False): see lm_loss —
+        # psum transposes to psum under shard_map, so the global psum'd loss
+        # must not sit inside the differentiated function
+        local_loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(model, p, tokens, seq_axis=seq_axis,
+                              tp_axis=tp_axis, reduce=False))(params)
+        loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
+        # Sum partial grads over seq (params replicated there, each shard
+        # holds part of the chain) and AVERAGE over data (the global
+        # objective is the mean of per-replica losses — matching
+        # allreduce_sgd's 1/n convention).  TP leaves need no collective:
+        # the f/g pattern leaves each slice's gradient exact.
+        dp = lax.psum(1, data_axis)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axes) / jnp.asarray(dp, g.dtype), grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+            params, grads)
+        return new_params, lax.pmean(loss, data_axis)
+
+    tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(pspecs, tok_spec),
+                           out_specs=(pspecs, P()),
+                           check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,76 @@
+"""3D-parallel LM train step: loss decreases; TP shards update consistently."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distlearn_tpu.models.transformer import transformer_lm
+from distlearn_tpu.train.lm import build_lm_step
+
+
+def test_lm_step_3d_mesh_loss_decreases():
+    dp, sp, tp = 2, 2, 2
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(dp, sp, tp),
+                ("data", "seq", "model"))
+    L = 16 * sp
+    model = transformer_lm(vocab=32, dim=64, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    step = build_lm_step(model, mesh, params, lr=0.1, donate=False)
+
+    rng = np.random.RandomState(0)
+    # learnable: repeated token pattern
+    base = rng.randint(0, 32, (1, L)).astype(np.int32)
+    tokens = jax.device_put(np.tile(base, (2 * dp, 1)),
+                            NamedSharding(mesh, P("data", "seq")))
+    losses = []
+    for _ in range(12):
+        params, loss = step(params, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_lm_step_gradients_match_single_device_all_mesh_shapes():
+    """The implied update (params - new_params)/lr must equal the
+    single-device gradient of the same global batch for every dp/sp/tp
+    factorization — guards the psum-transpose scaling bugs (dp unaveraged,
+    sp loss-psum, tp without the f/g pattern)."""
+    import jax.numpy as jnp
+    from distlearn_tpu.models.transformer import lm_loss
+    L = 32
+    model = transformer_lm(vocab=32, dim=32, depth=2, heads=4, max_len=L,
+                           dtype=jnp.float64)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32, (4, L)).astype(np.int32))
+    _, ref_g = jax.value_and_grad(lambda p: lm_loss(model, p, tokens))(params)
+
+    for dp, sp, tp in [(2, 1, 1), (1, 2, 1), (1, 1, 2), (2, 2, 2)]:
+        mesh = Mesh(np.array(jax.devices()[:dp * sp * tp]).reshape(dp, sp, tp),
+                    ("data", "seq", "model"))
+        step = build_lm_step(model, mesh, params, lr=1.0, donate=False)
+        tk = jax.device_put(tokens, NamedSharding(mesh, P("data", "seq")))
+        newp, _ = step(params, tk)
+        for a, b, g in zip(jax.tree_util.tree_leaves(params),
+                           jax.tree_util.tree_leaves(newp),
+                           jax.tree_util.tree_leaves(ref_g)):
+            implied = np.asarray(a) - np.asarray(b)
+            denom = max(1e-12, float(np.abs(np.asarray(g)).max()))
+            err = float(np.abs(implied - np.asarray(g)).max()) / denom
+            assert err < 1e-5, (dp, sp, tp, err)
+
+
+def test_lm_step_dp_only_matches_structure():
+    mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+    model = transformer_lm(vocab=32, dim=32, depth=1, heads=2, max_len=16)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    step = build_lm_step(model, mesh, params, lr=0.1, seq_axis=None,
+                         tp_axis=None, donate=False)
+    tokens = jax.device_put(
+        np.random.RandomState(0).randint(0, 32, (4, 16)).astype(np.int32),
+        NamedSharding(mesh, P("data")))
+    new_params, loss = step(params, tokens)
+    assert np.isfinite(float(loss))
+    # structure preserved
+    assert jax.tree_util.tree_structure(new_params) == \
+        jax.tree_util.tree_structure(params)

@@ -1,0 +1,71 @@
+"""Ring attention correctness: sharded-by-sequence blockwise result must
+match single-device full attention, causal and non-causal, including a
+gradient check (the backward pass also rides the ring)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distlearn_tpu.parallel.sequence import local_attention, ring_attention
+
+B, L, H, D = 2, 32, 4, 16
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, L, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_local(causal):
+    q, k, v = _qkv()
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), ("seq",))
+
+    ring = jax.jit(jax.shard_map(
+        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=causal),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    out = ring(q, k, v)
+    ref = local_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gradients_match():
+    q, k, v = _qkv(1)
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("seq",))
+
+    def ring_loss(qq, kk, vv):
+        mapped = jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "seq", causal=True),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False)
+        return jnp.sum(mapped(qq, kk, vv) ** 2)
+
+    def local_loss(qq, kk, vv):
+        return jnp.sum(local_attention(qq, kk, vv, causal=True) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_local = jax.grad(local_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_local):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_single_device_degenerate():
+    """axis size 1: ring attention == local attention exactly."""
+    q, k, v = _qkv(2)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
+    ring = jax.jit(jax.shard_map(
+        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(local_attention(q, k, v, causal=True)),
+        rtol=1e-5, atol=1e-6)
