@@ -105,6 +105,9 @@ def send_tensor_frame(fd: int, kind: int, meta: bytes, arr: np.ndarray) -> None:
 def recv_exact(fd: int, buf: memoryview, n: int) -> None:
     if n == 0:
         return
+    if n < 0 or n > buf.nbytes:
+        raise ValueError(f"recv_exact: {n} bytes into a {buf.nbytes}-byte "
+                         "buffer")
     lib = _load()
     addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
     rc = lib.dc_recv_exact(fd, addr, n)

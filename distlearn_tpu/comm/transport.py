@@ -96,11 +96,30 @@ class Conn:
         kind, length = self._recv_frame_header()
         if kind != ord("T"):
             raise ProtocolError(f"expected tensor, got kind {chr(kind)!r}")
+        if length < _THDR.size:
+            raise ProtocolError(f"tensor frame too short: {length} bytes")
         hlen = _THDR.unpack(bytes(self._recv_exact(_THDR.size)))[0]
-        header = json.loads(bytes(self._recv_exact(hlen)))
+        if _THDR.size + hlen > length:
+            raise ProtocolError(
+                f"tensor header length {hlen} exceeds frame length {length}")
+        raw = bytes(self._recv_exact(hlen))
         nbytes = length - _THDR.size - hlen
-        dtype = np.dtype(header["dtype"])
-        shape = tuple(header["shape"])
+        try:
+            header = json.loads(raw)
+            dtype = np.dtype(header["dtype"])
+            shape = tuple(int(s) for s in header["shape"])
+        except (ValueError, KeyError, TypeError) as e:
+            raise ProtocolError(f"bad tensor header: {e}") from None
+        if any(s < 0 for s in shape):
+            raise ProtocolError(f"negative dimension in shape {shape}")
+        expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if nbytes != expect:
+            # A desynced/corrupt peer must produce a protocol error, never an
+            # under/overrun of the receive buffer (ADVICE r1: the native
+            # recv path writes nbytes raw bytes into the target buffer).
+            raise ProtocolError(
+                f"tensor payload {nbytes} bytes != {expect} expected for "
+                f"{dtype}{shape}")
         if out is not None:
             if out.dtype != dtype or out.shape != shape:
                 raise ValueError(
@@ -144,14 +163,22 @@ class Server:
         """Accept ``n`` connections (ref ``server:clients(n, fn)`` accept side)."""
         new = []
         deadline = None if timeout is None else time.monotonic() + timeout
-        for _ in range(n):
-            if deadline is not None:
-                self.sock.settimeout(max(0.0, deadline - time.monotonic()))
-            c, _ = self.sock.accept()
-            conn = Conn(c)
-            self.conns.append(conn)
-            new.append(conn)
-        self.sock.settimeout(None)
+        try:
+            for _ in range(n):
+                if deadline is not None:
+                    self.sock.settimeout(max(0.0, deadline - time.monotonic()))
+                try:
+                    c, _ = self.sock.accept()
+                except (socket.timeout, BlockingIOError):
+                    # settimeout(0.0) = non-blocking -> BlockingIOError
+                    raise TimeoutError(
+                        f"accept timed out after {len(new)} of {n} "
+                        "connections") from None
+                conn = Conn(c)
+                self.conns.append(conn)
+                new.append(conn)
+        finally:
+            self.sock.settimeout(None)
         return new
 
     def recv_any(self, timeout: float | None = None) -> tuple[int, Any]:

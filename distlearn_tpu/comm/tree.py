@@ -208,10 +208,16 @@ class Tree:
     def scatter(self, value: PyTree) -> PyTree:
         """Root's values broadcast to every rank (ref ``tree.scatter``,
         lua/AllReduceSGD.lua:52)."""
-        leaves = [np.ascontiguousarray(np.asarray(x))
-                  for x in _jtu.tree_leaves(value)]
+        # Receiving ranks fill fresh buffers — aliasing the caller's arrays
+        # would silently overwrite its input (ADVICE r1).  Root copies so the
+        # returned tree is detached from the caller's too.
         if self._parent is not None:
-            leaves = [self._parent.recv_tensor(out=a) for a in leaves]
+            leaves = [self._parent.recv_tensor(
+                          out=np.empty(a.shape, a.dtype))
+                      for a in map(np.asarray, _jtu.tree_leaves(value))]
+        else:
+            leaves = [np.array(x, copy=True, order="C")
+                      for x in _jtu.tree_leaves(value)]
         for kid in self._kids:
             for a in leaves:
                 kid.send_tensor(a)
@@ -252,11 +258,17 @@ def tree_map_spawn(fn: Callable, n: int, *args, timeout: float = 120.0
         except Exception as e:  # noqa: BLE001 — surface in main thread
             errors.append((i, e))
 
-    threads = [threading.Thread(target=_run, args=(i,)) for i in range(n)]
+    threads = [threading.Thread(target=_run, args=(i,), daemon=True)
+               for i in range(n)]
     for t in threads:
         t.start()
+    deadline = time.monotonic() + timeout
     for t in threads:
-        t.join(timeout)
+        t.join(max(0.0, deadline - time.monotonic()))
+    stuck = [i for i, t in enumerate(threads) if t.is_alive()]
     if errors:
-        raise errors[0][1]
+        raise errors[0][1] if len(errors) == 1 else RuntimeError(
+            "; ".join(f"rank {i}: {e!r}" for i, e in sorted(errors)))
+    if stuck:
+        raise TimeoutError(f"ranks {stuck} still running after {timeout}s")
     return results

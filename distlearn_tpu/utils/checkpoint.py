@@ -109,5 +109,9 @@ def restore_checkpoint(directory: str, like: PyTree, step: int | None = None
         if arr.shape != want.shape:
             raise ValueError(
                 f"leaf {key!r}: checkpoint shape {arr.shape} != {want.shape}")
-        new_leaves.append(arr.astype(want.dtype))
+        if arr.dtype != want.dtype:
+            raise ValueError(
+                f"leaf {key!r}: checkpoint dtype {arr.dtype} != {want.dtype} "
+                "(restore into a matching-dtype template, or cast explicitly)")
+        new_leaves.append(arr)
     return jax.tree_util.tree_unflatten(treedef, new_leaves), meta

@@ -1,0 +1,59 @@
+"""Checkpoint/resume tests — atomic npz + sidecar meta
+(distlearn_tpu/utils/checkpoint.py; the reference only sketches this,
+examples/EASGD_server.lua:37-48)."""
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.utils import checkpoint as ckpt
+
+
+def _tree(dtype=np.float32):
+    return {"layer": {"w": np.arange(6, dtype=dtype).reshape(2, 3),
+                      "b": np.ones(3, dtype)},
+            "step_scale": np.asarray(2.0, dtype)}
+
+
+def test_save_restore_roundtrip(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 5, _tree(), metadata={"epoch": 1})
+    like = {"layer": {"w": np.zeros((2, 3), np.float32),
+                      "b": np.zeros(3, np.float32)},
+            "step_scale": np.zeros((), np.float32)}
+    tree, meta = ckpt.restore_checkpoint(d, like)
+    np.testing.assert_array_equal(tree["layer"]["w"], _tree()["layer"]["w"])
+    assert meta["step"] == 5 and meta["epoch"] == 1
+
+
+def test_restore_latest_and_prune(tmp_path):
+    d = str(tmp_path)
+    for s in (1, 2, 3, 4, 5):
+        ckpt.save_checkpoint(d, s, _tree(), keep=3)
+    assert ckpt.latest_step(d) == 5
+    assert sorted(ckpt._list_steps(d)) == [3, 4, 5]
+
+
+def test_shape_mismatch_raises(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 1, _tree())
+    bad = _tree()
+    bad["layer"]["w"] = np.zeros((3, 2), np.float32)
+    with pytest.raises(ValueError, match="shape"):
+        ckpt.restore_checkpoint(d, bad)
+
+
+def test_dtype_mismatch_raises(tmp_path):
+    """ADVICE r1: restoring into a different dtype must fail loudly, not
+    silently cast (precision loss)."""
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 1, _tree(np.float64))
+    with pytest.raises(ValueError, match="dtype"):
+        ckpt.restore_checkpoint(d, _tree(np.float32))
+
+
+def test_missing_leaf_raises(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 1, {"a": np.zeros(2, np.float32)})
+    with pytest.raises(KeyError):
+        ckpt.restore_checkpoint(d, {"a": np.zeros(2, np.float32),
+                                    "b": np.zeros(2, np.float32)})

@@ -1,0 +1,96 @@
+"""Transport-level protocol hardening tests (ADVICE r1): a desynced or
+corrupt peer must produce a ProtocolError, never a buffer under/overrun,
+and Server.accept must fail cleanly on timeout."""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.comm.transport import (Conn, ProtocolError, Server,
+                                          connect)
+
+
+def _pair():
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    a = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    a.connect(lst.getsockname())
+    b, _ = lst.accept()
+    lst.close()
+    return Conn(a), Conn(b)
+
+
+def test_tensor_roundtrip_and_buffer_reuse():
+    tx, rx = _pair()
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    tx.send_tensor(arr)
+    out = np.zeros((3, 4), np.float32)
+    got = rx.recv_tensor(out=out)
+    assert got is out
+    np.testing.assert_array_equal(out, arr)
+    tx.close(); rx.close()
+
+
+def test_corrupt_frame_payload_size_rejected():
+    """Frame length disagrees with header-declared shape*itemsize: the
+    receiver must raise ProtocolError before touching the data buffer."""
+    tx, rx = _pair()
+    header = b'{"dtype": "float32", "shape": [4]}'
+    payload = struct.pack("<I", len(header)) + header + b"\0" * 8  # 8 != 16
+    tx._send_frame(ord("T"), payload)
+    with pytest.raises(ProtocolError, match="payload"):
+        rx.recv_tensor()
+    tx.close(); rx.close()
+
+
+def test_header_longer_than_frame_rejected():
+    tx, rx = _pair()
+    payload = struct.pack("<I", 10_000) + b"x" * 4
+    tx._send_frame(ord("T"), payload)
+    with pytest.raises(ProtocolError, match="header"):
+        rx.recv_tensor()
+    tx.close(); rx.close()
+
+
+def test_negative_shape_rejected():
+    tx, rx = _pair()
+    header = b'{"dtype": "float32", "shape": [-1]}'
+    payload = struct.pack("<I", len(header)) + header
+    tx._send_frame(ord("T"), payload)
+    with pytest.raises(ProtocolError):
+        rx.recv_tensor()
+    tx.close(); rx.close()
+
+
+def test_recv_buffer_mismatch_rejected():
+    tx, rx = _pair()
+    tx.send_tensor(np.zeros(4, np.float32))
+    with pytest.raises(ValueError, match="mismatch"):
+        rx.recv_tensor(out=np.zeros(8, np.float32))
+    tx.close(); rx.close()
+
+
+def test_accept_timeout_restores_socket_and_names_count():
+    srv = Server("127.0.0.1", 0)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="0 of 2"):
+        srv.accept(2, timeout=0.2)
+    assert time.monotonic() - t0 < 5.0
+    # Listening socket must still work after the timeout (timeout cleared).
+    done = threading.Event()
+
+    def dial():
+        c = connect("127.0.0.1", srv.port)
+        done.set()
+        c.close()
+
+    th = threading.Thread(target=dial, daemon=True)
+    th.start()
+    got = srv.accept(1, timeout=5.0)
+    assert len(got) == 1 and done.wait(2.0)
+    srv.close()
