@@ -19,6 +19,7 @@ pure-Python socket IO.  ``recv_tensor(out=...)`` reuses a preallocated buffer
 from __future__ import annotations
 
 import json
+import math
 import select
 import socket
 import struct
@@ -112,7 +113,9 @@ class Conn:
             raise ProtocolError(f"bad tensor header: {e}") from None
         if any(s < 0 for s in shape):
             raise ProtocolError(f"negative dimension in shape {shape}")
-        expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        # Python-int product: immune to C-long overflow/wraparound from a
+        # hostile header; the nbytes equality below then rejects it.
+        expect = math.prod(shape) * dtype.itemsize
         if nbytes != expect:
             # A desynced/corrupt peer must produce a protocol error, never an
             # under/overrun of the receive buffer (ADVICE r1: the native

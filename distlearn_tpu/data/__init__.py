@@ -3,13 +3,14 @@ replacement for torch-dataset (reference call sites: examples/mnist.lua:26-40,
 examples/cifar10.lua:53-72, examples/Data.lua)."""
 
 from distlearn_tpu.data.dataset import (Dataset, make_dataset, load_npz,
-                                        synthetic_mnist, synthetic_cifar10)
+                                        synthetic_mnist, synthetic_cifar10,
+                                        synthetic_imagenet)
 from distlearn_tpu.data.samplers import (PermutationSampler, LabelUniformSampler,
                                          make_sampler)
 from distlearn_tpu.data.prefetch import prefetch_to_device, batch_iterator
 
 __all__ = [
-    "Dataset", "make_dataset", "load_npz", "synthetic_mnist", "synthetic_cifar10",
+    "Dataset", "make_dataset", "load_npz", "synthetic_mnist", "synthetic_cifar10", "synthetic_imagenet",
     "PermutationSampler", "LabelUniformSampler", "make_sampler",
     "prefetch_to_device", "batch_iterator",
 ]

@@ -102,3 +102,12 @@ def synthetic_cifar10(n: int = 4096, seed: int = 0):
     """CIFAR-shaped [n,32,32,3] synthetic set."""
     x, y = _synthetic_classification(n, (32, 32, 3), 10, seed)
     return x, y, 10
+
+
+def synthetic_imagenet(n: int = 256, image_size: int = 224,
+                       num_classes: int = 1000, seed: int = 0):
+    """ImageNet-shaped [n,S,S,3] synthetic set for the ResNet-50 stretch
+    config (BASELINE.md row 5; no dataset downloads in a zero-egress env)."""
+    x, y = _synthetic_classification(n, (image_size, image_size, 3),
+                                     num_classes, seed)
+    return x, y, num_classes

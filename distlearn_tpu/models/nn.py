@@ -64,37 +64,52 @@ def dense(params, x, compute_dtype=None):
 # Conv2D (NHWC x HWIO -> NHWC)
 # ---------------------------------------------------------------------------
 
-def conv2d_init(key, in_ch: int, out_ch: int, kh: int, kw: int, dtype=jnp.float32):
+def conv2d_init(key, in_ch: int, out_ch: int, kh: int, kw: int,
+                dtype=jnp.float32, bias: bool = True, init: str = "uniform"):
+    """``init``: 'uniform' (torch7 fanin default) or 'he' (Kaiming normal
+    fan-out — the torchvision ResNet init).  ``bias=False`` for convs
+    followed by batchnorm."""
     kk, kb = random.split(key)
     fan_in = in_ch * kh * kw
-    return {
-        "w": _uniform_fanin(kk, (kh, kw, in_ch, out_ch), fan_in, dtype),
-        "b": _uniform_fanin(kb, (out_ch,), fan_in, dtype),
-    }
+    if init == "he":
+        fan_out = out_ch * kh * kw
+        w = random.normal(kk, (kh, kw, in_ch, out_ch), dtype) \
+            * jnp.asarray(math.sqrt(2.0 / fan_out), dtype)
+    else:
+        w = _uniform_fanin(kk, (kh, kw, in_ch, out_ch), fan_in, dtype)
+    params = {"w": w}
+    if bias:
+        params["b"] = _uniform_fanin(kb, (out_ch,), fan_in, dtype)
+    return params
 
 
 def conv2d(params, x, stride=(1, 1), padding="VALID", compute_dtype=None):
     """x: [N,H,W,C]; kernel HWIO.  Padding: 'VALID' | 'SAME' | ((ph,ph),(pw,pw))."""
-    w, b = params["w"], params["b"]
+    w = params["w"]
     if compute_dtype is not None:
         x, w = x.astype(compute_dtype), w.astype(compute_dtype)
     y = lax.conv_general_dilated(
         x, w, window_strides=stride, padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
-    return y + b.astype(y.dtype)
+    b = params.get("b")
+    return y if b is None else y + b.astype(y.dtype)
 
 
 # ---------------------------------------------------------------------------
 # Pooling
 # ---------------------------------------------------------------------------
 
-def max_pool2d(x, window=(2, 2), stride=(2, 2)):
+def max_pool2d(x, window=(2, 2), stride=(2, 2), padding="VALID"):
+    """``padding``: 'VALID' | ((ph, ph), (pw, pw)) spatial pads."""
+    if padding != "VALID":
+        (pt, pb), (pl, pr) = padding
+        padding = ((0, 0), (pt, pb), (pl, pr), (0, 0))
     return lax.reduce_window(
         x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
         lax.max,
         window_dimensions=(1, window[0], window[1], 1),
         window_strides=(1, stride[0], stride[1], 1),
-        padding="VALID")
+        padding=padding)
 
 
 def avg_pool2d(x, window=(2, 2), stride=(2, 2)):

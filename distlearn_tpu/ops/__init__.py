@@ -1,7 +1,13 @@
-"""Pallas TPU kernels for the hot ops (fused updates; flat packing)."""
+"""Pallas TPU kernels for the hot ops (fused updates; flat/bucket packing)."""
 
-from distlearn_tpu.ops.flatten import FlatSpec, make_spec, pack, unpack
-from distlearn_tpu.ops.fused_update import fused_sgd, fused_elastic
+from distlearn_tpu.ops.flatten import (Bucket, BucketSpec, FlatSpec,
+                                       make_bucket_spec, make_spec, pack,
+                                       pack_buckets, unpack, unpack_buckets)
+from distlearn_tpu.ops.fused_update import (elastic_round_buckets,
+                                            fused_elastic, fused_enabled,
+                                            fused_sgd, sgd_update_buckets)
 
-__all__ = ["FlatSpec", "make_spec", "pack", "unpack",
-           "fused_sgd", "fused_elastic"]
+__all__ = ["Bucket", "BucketSpec", "FlatSpec", "make_bucket_spec",
+           "make_spec", "pack", "pack_buckets", "unpack", "unpack_buckets",
+           "elastic_round_buckets", "fused_elastic", "fused_enabled",
+           "fused_sgd", "sgd_update_buckets"]

@@ -11,7 +11,7 @@ concats XLA fuses away.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -60,4 +60,93 @@ def unpack(spec: FlatSpec, flat: jax.Array) -> PyTree:
                                     spec.offsets):
         leaves.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
                       .astype(dt).reshape(shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Dtype-grouped buckets — gradient bucketing for big models
+# ---------------------------------------------------------------------------
+
+class Bucket(NamedTuple):
+    """One contiguous flat buffer holding a run of same-dtype leaves."""
+    dtype: Any
+    idx: tuple[int, ...]                  # leaf indices (flatten order)
+    shapes: tuple[tuple[int, ...], ...]
+    sizes: tuple[int, ...]
+    offsets: tuple[int, ...]
+    padded: int                           # bucket length, multiple of TILE
+
+
+class BucketSpec(NamedTuple):
+    treedef: Any
+    n_leaves: int
+    buckets: tuple[Bucket, ...]
+
+
+def make_bucket_spec(tree: PyTree,
+                     max_bucket_bytes: int | None = None) -> BucketSpec:
+    """Plan packing of a pytree into per-dtype flat buckets.
+
+    Where the reference walks the parameter table tensor-by-tensor
+    (lua/AllReduceSGD.lua:24 walkTable update loop), the TPU path packs
+    leaves into a few large contiguous buffers so the gradient psum and the
+    fused update each stream once over HBM.  ``max_bucket_bytes`` caps a
+    bucket (ResNet-50-sized pytrees want several buckets so XLA can overlap
+    the psum of one with the update of another); ``None`` = one bucket per
+    dtype.  Mixed-dtype trees never share a bucket (no casting — bitwise
+    parity with the per-leaf path).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: dict[Any, list[int]] = {}
+    for i, l in enumerate(leaves):
+        groups.setdefault(jnp.asarray(l).dtype, []).append(i)
+    buckets = []
+    for dt, idxs in groups.items():
+        itemsize = np.dtype(dt).itemsize
+        cap = None if max_bucket_bytes is None else \
+            max(1, int(max_bucket_bytes) // itemsize)
+        chunk: list[int] = []
+        total = 0
+        for i in idxs + [None]:           # None = flush sentinel
+            size = None if i is None else \
+                int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
+            if i is None or (chunk and cap is not None
+                             and total + size > cap):
+                if chunk:
+                    sizes = tuple(
+                        int(np.prod(leaves[j].shape)) if leaves[j].shape else 1
+                        for j in chunk)
+                    offsets = tuple(int(x) for x in np.cumsum((0,) + sizes[:-1]))
+                    padded = ((sum(sizes) + TILE - 1) // TILE) * TILE
+                    buckets.append(Bucket(
+                        dtype=dt, idx=tuple(chunk),
+                        shapes=tuple(tuple(leaves[j].shape) for j in chunk),
+                        sizes=sizes, offsets=offsets, padded=padded))
+                chunk, total = [], 0
+            if i is not None:
+                chunk.append(i)
+                total += size
+    return BucketSpec(treedef=treedef, n_leaves=len(leaves),
+                      buckets=tuple(buckets))
+
+
+def pack_buckets(spec: BucketSpec, tree: PyTree) -> list[jax.Array]:
+    """Pack a pytree into the bucket buffers (one [padded] array each)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flats = []
+    for b in spec.buckets:
+        parts = [jnp.ravel(jnp.asarray(leaves[j])) for j in b.idx]
+        used = sum(b.sizes)
+        if b.padded > used:
+            parts.append(jnp.zeros(b.padded - used, b.dtype))
+        flats.append(jnp.concatenate(parts))
+    return flats
+
+
+def unpack_buckets(spec: BucketSpec, flats: Sequence[jax.Array]) -> PyTree:
+    leaves: list = [None] * spec.n_leaves
+    for b, flat in zip(spec.buckets, flats):
+        for j, shape, size, off in zip(b.idx, b.shapes, b.sizes, b.offsets):
+            leaves[j] = jax.lax.dynamic_slice_in_dim(flat, off, size) \
+                .reshape(shape)
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)

@@ -20,12 +20,31 @@ the CPU mesh exercise the identical code path.
 from __future__ import annotations
 
 import functools
+import os
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from distlearn_tpu.ops import flatten as flatten_lib
 from distlearn_tpu.ops.flatten import LANE, SUBLANE
+
+PyTree = Any
+
+
+def fused_enabled(override: bool | None = None) -> bool:
+    """Resolve whether trainers take the fused-kernel path.
+
+    Priority: explicit ``override`` > ``DISTLEARN_TPU_FUSED`` env (0/1) >
+    on-by-default on TPU, off elsewhere (interpret-mode Pallas on CPU is
+    correct but slower than XLA's own fusion, so it is opt-in there)."""
+    if override is not None:
+        return bool(override)
+    env = os.environ.get("DISTLEARN_TPU_FUSED")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off", "")
+    return jax.default_backend() == "tpu"
 
 _BLOCK_ROWS = 256  # rows of 128 lanes per grid step (128 KiB f32 per ref)
 
@@ -91,3 +110,40 @@ def fused_elastic(p_flat: jax.Array, c_flat: jax.Array, alpha: float
         interpret=_interpret(),
     )(p_flat.reshape(shape2d), c_flat.reshape(shape2d))
     return new_p.reshape(n), delta.reshape(n)
+
+
+# ---------------------------------------------------------------------------
+# Pytree-level wrappers over bucketed flat buffers (trainer hot path)
+# ---------------------------------------------------------------------------
+
+def sgd_update_buckets(spec: flatten_lib.BucketSpec,
+                       params: PyTree, grad_flats: list[jax.Array],
+                       lr: float) -> PyTree:
+    """Apply ``p' = p - lr*g`` where gradients are already packed (post-psum)
+    flat buckets; params are packed, updated by one kernel launch per bucket,
+    and unpacked.  Replaces the reference's per-tensor walkTable update loop
+    (examples/mnist.lua:112-116) with a few large streaming passes."""
+    p_flats = flatten_lib.pack_buckets(spec, params)
+    new = [fused_sgd(p, g, lr) for p, g in zip(p_flats, grad_flats)]
+    return flatten_lib.unpack_buckets(spec, new)
+
+
+def elastic_round_buckets(params: PyTree, center: PyTree, alpha: float,
+                          axis_name: str,
+                          max_bucket_bytes: int | None = None
+                          ) -> tuple[PyTree, PyTree]:
+    """The full EASGD round (lua/AllReduceEA.lua:35-45) on flat buckets:
+    one fused kernel produces (p', delta) per bucket, ONE psum per bucket
+    reduces the deltas (vs one per leaf), center moves on the flat buffer.
+    Returns ``(new_params, new_center)``."""
+    from jax import lax
+    spec = flatten_lib.make_bucket_spec(params, max_bucket_bytes)
+    p_flats = flatten_lib.pack_buckets(spec, params)
+    c_flats = flatten_lib.pack_buckets(spec, center)
+    new_p, new_c = [], []
+    for p, c in zip(p_flats, c_flats):
+        np_, d = fused_elastic(p, c, alpha)
+        new_p.append(np_)
+        new_c.append(c + lax.psum(d, axis_name))
+    return (flatten_lib.unpack_buckets(spec, new_p),
+            flatten_lib.unpack_buckets(spec, new_c))

@@ -34,6 +34,8 @@ from jax import lax, random
 from jax.sharding import PartitionSpec as P
 
 from distlearn_tpu.models.core import Model, loss_fn
+from distlearn_tpu.ops import flatten as flatten_lib
+from distlearn_tpu.ops import fused_update
 from distlearn_tpu.parallel import allreduce_ea, allreduce_sgd
 from distlearn_tpu.parallel import mesh as mesh_lib
 from distlearn_tpu.parallel.mesh import MeshTree
@@ -78,7 +80,9 @@ def init_train_state(model: Model, tree: MeshTree, key: jax.Array,
 
 
 def build_sgd_step(model: Model, tree: MeshTree, lr: float,
-                   donate: bool = True, with_contrib: bool = False) -> Callable:
+                   donate: bool = True, with_contrib: bool = False,
+                   fused: bool | None = None,
+                   max_bucket_bytes: int | None = None) -> Callable:
     """One fused AllReduceSGD step: ``step(ts, x, y) -> (ts, loss)``.
 
     ``x``/``y`` are GLOBAL batches (leading axis = global batch) sharded over
@@ -95,8 +99,17 @@ def build_sgd_step(model: Model, tree: MeshTree, lr: float,
     identical psum'd update (keeping params replicated), their step counter
     and confusion matrix do not advance; pair with :func:`build_sync_step`
     for the end-of-epoch winner-takes-all sync.
+
+    ``fused`` (default: on when running on TPU, see
+    :func:`distlearn_tpu.ops.fused_update.fused_enabled`) routes the gradient
+    psum and the SGD update through packed flat buckets: one collective and
+    one Pallas kernel launch per bucket instead of one XLA op per parameter
+    leaf — the per-tensor walkTable loop of the reference
+    (lua/AllReduceSGD.lua:24) collapsed into a few HBM streaming passes.
+    ``max_bucket_bytes`` splits huge models into several buckets.
     """
     axis = tree.axis_name
+    use_fused = fused_update.fused_enabled(fused)
 
     def _body(ts: TrainState, x, y, contrib):
         rng, dropout_rng = random.split(ts.rng)
@@ -109,10 +122,18 @@ def build_sgd_step(model: Model, tree: MeshTree, lr: float,
         (loss, (log_probs, mstate)), grads = \
             jax.value_and_grad(_loss, has_aux=True)(ts.params)
         sync_local = mesh_lib.squeeze_node(ts.sync)
-        grads, sync_local, n = allreduce_sgd.sum_and_normalize_gradients(
-            grads, sync_local, contrib=contrib, axis_name=axis)
+        if use_fused:
+            spec = flatten_lib.make_bucket_spec(grads, max_bucket_bytes)
+            g_flats, sync_local, n = allreduce_sgd.sum_and_normalize_gradients(
+                flatten_lib.pack_buckets(spec, grads), sync_local,
+                contrib=contrib, axis_name=axis)
+            params = fused_update.sgd_update_buckets(spec, ts.params,
+                                                     g_flats, lr)
+        else:
+            grads, sync_local, n = allreduce_sgd.sum_and_normalize_gradients(
+                grads, sync_local, contrib=contrib, axis_name=axis)
+            params = _sgd_update(ts.params, grads, lr)
         sync = mesh_lib.expand_node(sync_local)
-        params = _sgd_update(ts.params, grads, lr)
         cm_new = metrics_lib.update_confusion(jnp.squeeze(ts.cm, 0),
                                               log_probs, y)
         if contrib is not None:
@@ -222,7 +243,9 @@ def init_ea_state(model: Model, tree: MeshTree, key: jax.Array,
 
 
 def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
-                   donate: bool = True) -> tuple[Callable, Callable]:
+                   donate: bool = True, fused: bool | None = None,
+                   max_bucket_bytes: int | None = None
+                   ) -> tuple[Callable, Callable]:
     """Returns ``(local_step, ea_round)``.
 
     ``local_step(ts, x, y) -> (ts, losses)`` — grad + local SGD, ZERO
@@ -231,9 +254,13 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
     running stats are process-local buffers).
 
     ``ea_round(ts) -> ts`` — the fused elastic round (delta, psum, center
-    move) — lua/AllReduceEA.lua:35-45 as ONE XLA program.
+    move) — lua/AllReduceEA.lua:35-45 as ONE XLA program.  With ``fused``
+    (default on TPU) the round runs on packed flat buckets: one Pallas
+    kernel produces (p', delta) and ONE psum per bucket carries the deltas,
+    instead of a collective per parameter leaf.
     """
     axis = tree.axis_name
+    use_fused = fused_update.fused_enabled(fused)
     _sq, _ex = mesh_lib.squeeze_node, mesh_lib.expand_node
 
     def local_step(ts: EATrainState, x, y):
@@ -255,10 +282,16 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
 
     def ea_round(ts: EATrainState):
         params, center = _sq(ts.params), _sq(ts.center)
-        st = allreduce_ea.EAState(center=center, step=jnp.zeros((), jnp.int32))
-        params, st = allreduce_ea.elastic_round(params, st, alpha,
-                                                axis_name=axis)
-        return EATrainState(_ex(params), ts.model_state, _ex(st.center),
+        if use_fused:
+            params, center = fused_update.elastic_round_buckets(
+                params, center, alpha, axis, max_bucket_bytes)
+        else:
+            st = allreduce_ea.EAState(center=center,
+                                      step=jnp.zeros((), jnp.int32))
+            params, st = allreduce_ea.elastic_round(params, st, alpha,
+                                                    axis_name=axis)
+            center = st.center
+        return EATrainState(_ex(params), ts.model_state, _ex(center),
                             ts.cm, ts.rng)
 
     spec_ts = EATrainState(params=P(axis), model_state=P(axis), center=P(axis),

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Distributed ResNet-50 v1.5 training with AllReduceSGD — the ImageNet-scale
+stretch config (BASELINE.md "Benchmark configs to reproduce" row 5; the
+reference tops out at the CIFAR convnet, examples/cifar10.lua).
+
+The 25.6M-parameter / 161-leaf pytree is where gradient bucketing matters:
+``--bucketMB`` packs gradients into flat buckets so the cross-node psum and
+the fused Pallas SGD update stream over HBM once per bucket instead of once
+per tensor (distlearn_tpu/ops/flatten.py).
+
+Run:  python examples/resnet50.py --numNodes 8 --batchSize 256
+      python examples/resnet50.py --tpu --numNodes 1 --batchSize 256 --bf16
+"""
+
+from __future__ import annotations
+
+from common import setup_platform, device_stream
+from distlearn_tpu.utils.flags import parse_flags, NODE_FLAGS, TRAIN_FLAGS
+
+
+def main():
+    opt = parse_flags("Train ResNet-50 v1.5.", {
+        **NODE_FLAGS,
+        **TRAIN_FLAGS,
+        "batchSize": (256, "global batch size"),
+        "imageSize": (224, "square image edge"),
+        "numClasses": (1000, "label count"),
+        "numExamples": (2048, "synthetic dataset size"),
+        "data": ("", "path to .npz with x [N,S,S,3]/y (default: synthetic)"),
+        "save": ("", "checkpoint dir (empty = off)"),
+        "resume": (False, "resume from newest checkpoint in --save"),
+        "bf16": (False, "bfloat16 compute (MXU path)"),
+        "bucketMB": (16, "gradient bucket size in MiB (0 = one bucket)"),
+        "stepsPerEpoch": (0, "cap steps per epoch (0 = full epoch)"),
+    })
+    setup_platform(opt.numNodes, opt.tpu)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import random
+
+    from distlearn_tpu.data import (PermutationSampler, load_npz,
+                                    make_dataset, synthetic_imagenet)
+    from distlearn_tpu.models import param_count, resnet50
+    from distlearn_tpu.parallel.mesh import MeshTree
+    from distlearn_tpu.train import (build_sgd_step, build_sync_step,
+                                     init_train_state, reduce_confusion)
+    from distlearn_tpu.utils import checkpoint as ckpt
+    from distlearn_tpu.utils import metrics as M
+    from distlearn_tpu.utils.logging import root_print
+    from distlearn_tpu.utils.profiling import StepTimer
+
+    log = root_print(0)
+    tree = MeshTree(num_nodes=opt.numNodes)
+    log(f"mesh: {tree.num_nodes} nodes on {jax.devices()[0].platform}")
+
+    if opt.data:
+        x, y, nc = load_npz(opt.data)
+    else:
+        x, y, nc = synthetic_imagenet(opt.numExamples, opt.imageSize,
+                                      opt.numClasses, seed=opt.seed)
+    ds = make_dataset(x, y, nc)
+
+    model = resnet50(num_classes=nc, image_size=opt.imageSize,
+                     compute_dtype=jnp.bfloat16 if opt.bf16 else None)
+    ts = init_train_state(model, tree, random.PRNGKey(opt.seed), nc)
+    log(f"resnet50: {param_count(ts.params):,} params, "
+        f"{len(jax.tree_util.tree_leaves(ts.params))} leaves, "
+        f"bucket {opt.bucketMB} MiB")
+    step = build_sgd_step(
+        model, tree, lr=opt.learningRate,
+        max_bucket_bytes=opt.bucketMB * 1024 * 1024 if opt.bucketMB else None)
+    sync = build_sync_step(tree)
+
+    start_epoch = 1
+    if opt.resume and opt.save and ckpt.latest_step(opt.save) is not None:
+        restorable = {"params": ts.params, "model_state": ts.model_state}
+        restored, meta = ckpt.restore_checkpoint(opt.save, restorable)
+        ts = ts._replace(params=restored["params"],
+                         model_state=restored["model_state"])
+        start_epoch = meta["step"] + 1
+        log(f"resumed from epoch {meta['step']}")
+
+    timer = StepTimer()
+    for epoch in range(start_epoch, opt.numEpochs + 1):
+        sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
+        for i, (bx, by) in enumerate(
+                device_stream(tree, ds, sampler, opt.batchSize)):
+            timer.tick()
+            ts, loss = step(ts, bx, by)
+            if opt.stepsPerEpoch and i + 1 >= opt.stepsPerEpoch:
+                break
+        ts = sync(ts)
+        cm = reduce_confusion(ts.cm)
+        ts = ts._replace(cm=jax.tree_util.tree_map(lambda c: c * 0, ts.cm))
+        log(f"epoch {epoch}: loss {float(loss):.4f} "
+            f"train {M.format_confusion(cm)} "
+            f"({timer.steps_per_sec():.2f} steps/s)")
+        if opt.save:
+            ckpt.save_checkpoint(
+                opt.save, epoch,
+                {"params": ts.params, "model_state": ts.model_state},
+                metadata={"epoch": epoch})
+    jax.block_until_ready(ts.params)
+    log("done")
+
+
+if __name__ == "__main__":
+    main()

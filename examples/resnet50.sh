@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# ResNet-50 v1.5 stretch config (BASELINE.md row 5).  One SPMD process
+# drives all nodes (vs the reference's process-per-node .sh pattern).
+# CPU smoke: tiny images + capped steps so it finishes in minutes.
+set -e
+cd "$(dirname "$0")"
+python resnet50.py --numNodes 8 --batchSize 64 --imageSize 64 \
+  --numExamples 256 --numClasses 100 --numEpochs 1 --stepsPerEpoch 4 "$@"

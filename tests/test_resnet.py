@@ -1,0 +1,57 @@
+"""ResNet-50 v1.5 stretch model (BASELINE.md row 5): structure parity with
+the torchvision reference config, and the bucketed-gradient distributed step
+on the 8-device mesh."""
+
+import jax
+import numpy as np
+from jax import random
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distlearn_tpu.models import param_count, resnet
+from distlearn_tpu.parallel.mesh import MeshTree
+from distlearn_tpu.train import build_sgd_step, init_train_state
+
+
+def test_resnet50_param_count_matches_torchvision():
+    m = resnet(50, num_classes=1000)
+    params, state = m.init(random.PRNGKey(0))
+    assert param_count(params) == 25_557_032  # torchvision resnet50
+    assert len(jax.tree_util.tree_leaves(params)) == 161
+
+
+def test_resnet50_forward_shapes_and_zero_gamma():
+    m = resnet(50, num_classes=10)
+    params, state = m.init(random.PRNGKey(0))
+    # zero-init residual gamma: block output == shortcut at init
+    assert float(np.abs(np.asarray(
+        params["stage1_block1"]["bn3"]["scale"])).max()) == 0.0
+    x = np.random.RandomState(0).randn(2, 64, 64, 3).astype(np.float32)
+    lp, ns = m.apply(params, state, x, train=True)
+    assert lp.shape == (2, 10)
+    np.testing.assert_allclose(np.exp(np.asarray(lp, np.float64)).sum(-1),
+                               1.0, rtol=1e-4)
+    # eval path uses running stats
+    lp2, _ = m.apply(params, ns, x, train=False)
+    assert lp2.shape == (2, 10)
+
+
+def test_resnet_distributed_bucketed_step():
+    """The full data-parallel fused+bucketed step on the 8-device mesh —
+    the dryrun-style gate for the stretch config (VERDICT r1 #4)."""
+    tree = MeshTree(num_nodes=8)
+    m = resnet(50, num_classes=10, image_size=32)
+    ts = init_train_state(m, tree, random.PRNGKey(0), 10)
+    step = build_sgd_step(m, tree, lr=0.1, fused=True,
+                          max_bucket_bytes=8 * 1024 * 1024)
+    sh = NamedSharding(tree.mesh, P(tree.axis_name))
+    x = jax.device_put(np.random.RandomState(0)
+                       .randn(8, 32, 32, 3).astype(np.float32), sh)
+    y = jax.device_put(np.arange(8, dtype=np.int32) % 10, sh)
+    ts, loss = step(ts, x, y)
+    ts, loss2 = step(ts, x, y)
+    assert np.isfinite(float(loss)) and np.isfinite(float(loss2))
+    # params stay replicated bitwise across shards
+    leaf = jax.tree_util.tree_leaves(ts.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
