@@ -80,10 +80,19 @@ def available() -> bool:
     return _load() is not None
 
 
+import errno as _errno
+
+_TIMEOUT_ERRNOS = {_errno.EAGAIN, _errno.EWOULDBLOCK, _errno.ETIMEDOUT}
+
+
 def _check_rc(rc: int, what: str) -> None:
     if rc == -1:
         raise ConnectionError("peer closed connection")
     if rc != 0:
+        if -rc in _TIMEOUT_ERRNOS:
+            # SO_RCVTIMEO/SO_SNDTIMEO expired mid-operation (the per-handshake
+            # timeout of the AsyncEA server) — distinct from a dead peer.
+            raise TimeoutError(f"{what} timed out (socket timeout)")
         raise ConnectionError(f"{what} failed: {os.strerror(-rc)}")
 
 
@@ -110,11 +119,7 @@ def recv_exact(fd: int, buf: memoryview, n: int) -> None:
                          "buffer")
     lib = _load()
     addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
-    rc = lib.dc_recv_exact(fd, addr, n)
-    if rc == -1:
-        raise ConnectionError("peer closed connection")
-    if rc != 0:
-        raise ConnectionError(f"dc_recv_exact failed: {os.strerror(-rc)}")
+    _check_rc(lib.dc_recv_exact(fd, addr, n), "dc_recv_exact")
 
 
 def reduce_inplace(dst: np.ndarray, src: np.ndarray, op: str = "sum") -> None:

@@ -42,25 +42,46 @@ class Conn:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._fd = sock.fileno()
 
+    def set_timeout(self, seconds: float | None):
+        """Kernel-level send/recv timeout (SO_RCVTIMEO/SO_SNDTIMEO) so that a
+        dead or hung peer turns a blocking IO into :class:`TimeoutError`
+        instead of a wedge.  Set at the fd level (not ``settimeout``) so the
+        native C++ recv/send loops honor it too.  ``None`` disables."""
+        if seconds is None:
+            tv = struct.pack("ll", 0, 0)
+        else:
+            if seconds <= 0:
+                raise ValueError("timeout must be positive or None")
+            tv = struct.pack("ll", int(seconds),
+                             int((seconds - int(seconds)) * 1e6))
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+
     # -- low-level framing --------------------------------------------------
     def _send_frame(self, kind: int, payload: bytes | memoryview):
-        if native.available():
-            native.send_frame(self._fd, kind, payload)
-        else:
-            self.sock.sendall(_HDR.pack(kind, len(payload)))
-            self.sock.sendall(payload)
+        try:
+            if native.available():
+                native.send_frame(self._fd, kind, payload)
+            else:
+                self.sock.sendall(_HDR.pack(kind, len(payload)))
+                self.sock.sendall(payload)
+        except (BlockingIOError, InterruptedError) as e:
+            raise TimeoutError("send timed out (socket timeout)") from e
 
     def _recv_exact(self, n: int, out: memoryview | None = None) -> memoryview:
         buf = out if out is not None else memoryview(bytearray(n))
-        if native.available():
-            native.recv_exact(self._fd, buf, n)
-            return buf
-        got = 0
-        while got < n:
-            r = self.sock.recv_into(buf[got:], n - got)
-            if r == 0:
-                raise ConnectionError("peer closed connection")
-            got += r
+        try:
+            if native.available():
+                native.recv_exact(self._fd, buf, n)
+                return buf
+            got = 0
+            while got < n:
+                r = self.sock.recv_into(buf[got:], n - got)
+                if r == 0:
+                    raise ConnectionError("peer closed connection")
+                got += r
+        except BlockingIOError as e:   # SO_RCVTIMEO expired -> EAGAIN
+            raise TimeoutError("recv timed out (socket timeout)") from e
         return buf
 
     def _recv_frame_header(self) -> tuple[int, int]:
@@ -85,13 +106,16 @@ class Conn:
         header = json.dumps({"dtype": arr.dtype.name,
                              "shape": list(arr.shape)}).encode()
         meta = _THDR.pack(len(header)) + header
-        if native.available():
-            # zero-copy: numpy buffer goes straight into the writev
-            native.send_tensor_frame(self._fd, ord("T"), meta, arr)
-            return
-        self.sock.sendall(_HDR.pack(ord("T"), len(meta) + arr.nbytes))
-        self.sock.sendall(meta)
-        self.sock.sendall(memoryview(arr).cast("B"))
+        try:
+            if native.available():
+                # zero-copy: numpy buffer goes straight into the writev
+                native.send_tensor_frame(self._fd, ord("T"), meta, arr)
+                return
+            self.sock.sendall(_HDR.pack(ord("T"), len(meta) + arr.nbytes))
+            self.sock.sendall(meta)
+            self.sock.sendall(memoryview(arr).cast("B"))
+        except (BlockingIOError, InterruptedError) as e:
+            raise TimeoutError("send timed out (socket timeout)") from e
 
     def recv_tensor(self, out: np.ndarray | None = None) -> np.ndarray:
         kind, length = self._recv_frame_header()
@@ -205,8 +229,11 @@ class Server:
                 i = live[sock]
                 try:
                     return i, self.conns[i].recv_msg()
-                except ConnectionError:
-                    self.conns[i].close()  # EOF: drop peer, keep waiting
+                except (ConnectionError, ProtocolError, ValueError):
+                    # EOF, a non-control frame, or undecodable bytes: that
+                    # peer is broken/desynced (its stream can't be resumed) —
+                    # drop it and keep serving the rest.
+                    self.conns[i].close()
 
     def close(self):
         for c in self.conns:

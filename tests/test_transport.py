@@ -94,3 +94,22 @@ def test_accept_timeout_restores_socket_and_names_count():
     got = srv.accept(1, timeout=5.0)
     assert len(got) == 1 and done.wait(2.0)
     srv.close()
+
+
+def test_recv_any_drops_desynced_peer_keeps_serving():
+    """A peer that puts a non-control frame (or garbage) on the control
+    channel must be dropped by recv_any, not crash the server loop."""
+    srv = Server("127.0.0.1", 0)
+    bad = connect("127.0.0.1", srv.port)
+    good = connect("127.0.0.1", srv.port)
+    srv.accept(2, timeout=5.0)
+    bad.send_tensor(np.arange(4, dtype=np.float32))   # wrong frame kind
+    time.sleep(0.2)                                   # bad's frame lands first
+    t = threading.Timer(0.5, lambda: good.send_msg({"q": "hello"}))
+    t.start()
+    # One call must survive the desynced peer and return the good message.
+    _, msg = srv.recv_any(timeout=10.0)
+    assert msg == {"q": "hello"}
+    open_conns = [c for c in srv.conns if c.sock.fileno() >= 0]
+    assert len(open_conns) == 1                       # bad peer was dropped
+    t.join(); bad.close(); good.close(); srv.close()
