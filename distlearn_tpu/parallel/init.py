@@ -1,0 +1,109 @@
+"""Multi-host bootstrap — pod-scale mesh construction over DCN.
+
+The reference runs across machines by hand-wiring sockets: node 1 calls
+``ipc.server``, every other host dials it, and each passes the resulting
+server/client into ``ipc.Tree`` (examples/client_remote.lua:34-41,
+examples/AsyncEASGD.sh ssh'd remote clients).  The TPU-native equivalent is
+``jax.distributed.initialize``: every process dials one coordinator, after
+which ``jax.devices()`` spans ALL hosts' chips and one SPMD program runs
+over a global :class:`~distlearn_tpu.parallel.mesh.MeshTree` — collectives
+ride ICI within a slice and DCN across slices, scheduled by XLA rather than
+a hand-rolled socket tree.
+
+Two deployment shapes, mirroring the reference's two:
+
+* **Global-mesh SPMD** (this module): all hosts join one mesh; the fused
+  train steps (distlearn_tpu.train) need no changes — the mesh just has
+  more devices.  Per-host input shards become one global batch via
+  :func:`host_local_batch`.
+* **Process-per-node over the TCP tree** (examples/client_remote.py): each
+  host trains independently and syncs through
+  distlearn_tpu.parallel.host_algorithms — the reference's own topology,
+  for clusters without a shared XLA runtime.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+PyTree = object
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    process_id: int
+    num_processes: int
+    local_devices: int
+    global_devices: int
+
+    @property
+    def is_root(self) -> bool:
+        return self.process_id == 0
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               local_device_count: int | None = None) -> ProcessInfo:
+    """Join (or create) the multi-process JAX runtime.
+
+    Args mirror ``jax.distributed.initialize``; each falls back to the
+    ``DISTLEARN_COORDINATOR`` / ``DISTLEARN_NUM_PROCESSES`` /
+    ``DISTLEARN_PROCESS_ID`` env vars, and to JAX's own auto-detection
+    (cloud TPU metadata) when ``None`` everywhere — so on a real TPU pod
+    slice ``initialize()`` with no arguments does the right thing.
+
+    ``local_device_count`` forces that many *virtual CPU devices* on this
+    process — the single-machine stand-in for per-host chips (tests /
+    examples; same trick as SURVEY.md §4's ipc.map analogue).  Call BEFORE
+    any other jax device query.
+    """
+    coordinator = coordinator or os.environ.get("DISTLEARN_COORDINATOR")
+    if num_processes is None and "DISTLEARN_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DISTLEARN_NUM_PROCESSES"])
+    if process_id is None and "DISTLEARN_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DISTLEARN_PROCESS_ID"])
+
+    import jax
+    if local_device_count:
+        from distlearn_tpu.utils.platform import force_cpu
+        force_cpu(local_device_count)
+    if local_device_count or \
+            os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # Cross-process collectives on the CPU backend need gloo (the
+        # single-machine / CI stand-in for ICI+DCN).  Checked via env, NOT
+        # jax.default_backend(): querying the backend here would initialize
+        # it before jax.distributed.initialize and break the pod path.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return ProcessInfo(process_id=jax.process_index(),
+                       num_processes=jax.process_count(),
+                       local_devices=jax.local_device_count(),
+                       global_devices=jax.device_count())
+
+
+def global_mesh_tree(axis_name: str = "data"):
+    """A :class:`MeshTree` spanning every device of every joined process —
+    the pod-scale ``tree`` handle.  num_nodes == global device count; the
+    fused train steps work unchanged on it."""
+    import jax
+
+    from distlearn_tpu.parallel.mesh import MeshTree
+    return MeshTree(devices=jax.devices(), axis_name=axis_name)
+
+
+def host_local_batch(tree, array) -> object:
+    """Assemble a GLOBAL batch from this process's host-local shard.
+
+    Every process passes its local slice (leading axis = per-host batch);
+    the result is one global jax.Array sharded over ``tree``'s axis with
+    global leading size ``num_processes * per_host``.  This is the
+    multi-host replacement for ``device_put(x, sharding)``, which only
+    works when one process addresses all devices.
+    """
+    import jax
+
+    return jax.make_array_from_process_local_data(tree.node_sharding, array)

@@ -161,22 +161,31 @@ class MeshTree:
         return P(self.axis_name)
 
     # -- data movement -----------------------------------------------------
+    def _put_global(self, x, sharding: NamedSharding):
+        """Host value -> global jax.Array under ``sharding``.  Built with
+        ``make_array_from_callback`` so it also works when the mesh spans
+        multiple processes (jax.distributed) and this process addresses only
+        some devices — ``device_put`` would reject that."""
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
     def put_per_node(self, tree: PyTree) -> PyTree:
         """Place a stacked pytree (leading axis == num_nodes) onto the mesh."""
         def _put(x):
-            x = jnp.asarray(x)
+            x = np.asarray(x)
             if x.shape[0] != self.num_nodes:
                 raise ValueError(
                     f"leading axis {x.shape[0]} != num_nodes {self.num_nodes}")
-            return jax.device_put(x, self.node_sharding)
+            return self._put_global(x, self.node_sharding)
         return jax.tree_util.tree_map(_put, tree)
 
     def replicate(self, tree: PyTree) -> PyTree:
         """Stack one value to all nodes: v -> [num_nodes, *v.shape], sharded."""
         def _rep(x):
-            x = jnp.asarray(x)
-            stacked = jnp.broadcast_to(x[None], (self.num_nodes,) + x.shape)
-            return jax.device_put(stacked, self.node_sharding)
+            x = np.asarray(x)
+            stacked = np.broadcast_to(x[None], (self.num_nodes,) + x.shape)
+            return self._put_global(stacked, self.node_sharding)
         return jax.tree_util.tree_map(_rep, tree)
 
     # -- collectives on stacked node arrays --------------------------------

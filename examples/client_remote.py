@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Multi-host training over the TCP tree backend — the working counterpart
+of the reference's ``examples/client_remote.lua``.
+
+The reference's script wires an explicit multi-host topology — node 1 runs
+``ipc.server``, every other host dials it, all build ``ipc.Tree`` over TCP
+(client_remote.lua:34-41) — but is stale: it calls AsyncEA with
+AllReduceEA's API (client_remote.lua:43,158-236 vs lua/AsyncEA.lua:294-303),
+so it documents the intended topology without running (SURVEY.md §2a row
+"client_remote").  This is that intent, working: each PROCESS (one per
+host) trains locally — on its own accelerator with ``--tpu``, else CPU —
+and synchronizes elastically through distlearn_tpu.comm.tree over DCN,
+with the reference's AllReduceEA semantics (host_algorithms).
+
+Single machine (two "hosts" as processes — client_remote.sh):
+
+    python examples/client_remote.py --nodeIndex 1 --numNodes 2 &
+    python examples/client_remote.py --nodeIndex 2 --numNodes 2 &
+
+Across real machines: run node 1 on the coordinator host, point the others
+at it, and tell each rank how it can be reached::
+
+    host-a$ python examples/client_remote.py --nodeIndex 1 --numNodes 2 \
+                --host 0.0.0.0 --advertiseHost host-a --port 9090
+    host-b$ python examples/client_remote.py --nodeIndex 2 --numNodes 2 \
+                --host host-a --listenHost 0.0.0.0 --advertiseHost host-b
+
+(For pod-scale SPMD over a shared XLA runtime use
+``distlearn_tpu.parallel.init`` / ``jax.distributed.initialize`` instead —
+this script is the socket-tree deployment shape.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from common import setup_platform
+from distlearn_tpu.utils.flags import (parse_flags, EA_FLAGS, NODE_FLAGS,
+                                       TRAIN_FLAGS)
+
+
+def main():
+    opt = parse_flags("Multi-host elastic-averaging training (TCP tree).", {
+        **NODE_FLAGS,
+        **TRAIN_FLAGS,
+        **EA_FLAGS,
+        "host": ("127.0.0.1", "rank-0 coordinator address every node dials "
+                              "(client_remote.lua:8,34-39)"),
+        "port": (9090, "coordinator port (client_remote.lua:9)"),
+        "base": (2, "tree fan-out (client_remote.lua:12)"),
+        "listenHost": ("", "local bind address for this rank's child "
+                           "listener (multi-host: 0.0.0.0)"),
+        "advertiseHost": ("", "address other ranks dial to reach this rank"),
+        "learningRate": (0.01, "local SGD learning rate"),
+        "numExamples": (2048, "synthetic dataset size (global)"),
+        "data": ("", "path to .npz with x [N,32,32,1]/y (default: synthetic)"),
+    })
+    # One process == one node here (the reference's process-per-host shape):
+    # no virtual device mesh, just this host's backend.
+    setup_platform(1, opt.tpu)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random, value_and_grad
+
+    from distlearn_tpu.comm.tree import Tree
+    from distlearn_tpu.data import PermutationSampler, load_npz, make_dataset, \
+        synthetic_mnist
+    from distlearn_tpu.data.dataset import per_node_batch_size
+    from distlearn_tpu.models import mnist_cnn
+    from distlearn_tpu.models.core import loss_fn
+    from distlearn_tpu.parallel.host_algorithms import TreeAllReduceEA
+    from distlearn_tpu.utils.logging import root_print
+
+    rank = opt.nodeIndex - 1            # reference nodeIndex is 1-based
+    log = root_print(rank)
+    tree = Tree(rank, opt.numNodes, opt.host, opt.port, base=opt.base,
+                listen_host=opt.listenHost or None,
+                advertise_host=opt.advertiseHost or None)
+    log(f"tree up: {opt.numNodes} nodes, base {opt.base}, "
+        f"platform {jax.devices()[0].platform}")
+
+    if opt.data:
+        x, y, nc = load_npz(opt.data)
+    else:
+        x, y, nc = synthetic_mnist(opt.numExamples, seed=opt.seed)
+    ds = make_dataset(x, y, nc, partition=rank, partitions=opt.numNodes)
+    per_node = per_node_batch_size(opt.batchSize, opt.numNodes)
+
+    model = mnist_cnn()
+    params, mstate = model.init(random.PRNGKey(opt.seed))  # same seed: same init
+    ea = TreeAllReduceEA(tree, tau=opt.communicationTime, alpha=opt.alpha)
+    params = ea.synchronize_parameters(params)   # initial scatter (lua :63-ish)
+
+    @jax.jit
+    def local_step(p, s, bx, by):
+        (loss, (_, s)), grads = value_and_grad(
+            lambda q: loss_fn(model, q, s, bx, by, train=True),
+            has_aux=True)(p)
+        p = jax.tree_util.tree_map(
+            lambda w, g: w - jnp.asarray(opt.learningRate, w.dtype) * g, p, grads)
+        return p, s, loss
+
+    for epoch in range(1, opt.numEpochs + 1):
+        sampler = PermutationSampler(ds.size, seed=opt.seed + epoch + rank)
+        losses = []
+        for idx in sampler.epoch(per_node):
+            params, mstate, loss = local_step(
+                params, mstate, ds.x[idx], ds.y[idx])
+            losses.append(float(loss))
+            # elastic round every tau-th step, zero comm otherwise
+            params = ea.average_parameters(jax.device_get(params))
+        params = ea.synchronize_center(jax.device_get(params))
+        log(f"epoch {epoch}: mean loss {np.mean(losses):.4f}")
+
+    params = ea.synchronize_parameters(jax.device_get(params))
+    flat = np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree_util.tree_leaves(params)])
+    digest = hashlib.sha256(flat.tobytes()).hexdigest()[:16]
+    # identical on every node — the reference's own sync oracle
+    # (test_AllReduceSGD.lua:38)
+    print(f"[node {opt.nodeIndex}] final params digest {digest}")
+    tree.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,140 @@
+"""Multi-host tests — real OS processes, not threads (VERDICT r1 #3).
+
+Two deployment shapes, each spawned with ``multiprocessing`` (spawn context:
+fresh interpreters, like the reference's fresh-Lua-state workers):
+
+* TCP-tree process-per-host training (the examples/client_remote.py shape):
+  ranks train unevenly and synchronize through the socket tree; oracle =
+  bitwise-identical params after sync (ref test_AllReduceSGD.lua:38).
+* ``jax.distributed`` global-mesh SPMD (distlearn_tpu.parallel.init): two
+  processes × two virtual CPU devices join one 4-device mesh and run the
+  fused AllReduceSGD step; oracle = bitwise-identical replicated params on
+  every process.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import multiprocessing as mp
+import os
+import sys
+
+import numpy as np
+
+from tests.net_util import reserve_port_window
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _digest(leaves) -> str:
+    flat = np.concatenate([np.asarray(x, np.float64).ravel() for x in leaves])
+    return hashlib.sha256(flat.tobytes()).hexdigest()
+
+
+def _tcp_worker(rank: int, n: int, port: int, q) -> None:
+    sys.path.insert(0, _REPO)
+    import numpy as np
+
+    from distlearn_tpu.comm.tree import LocalhostTree
+    from distlearn_tpu.parallel.host_algorithms import TreeAllReduceSGD
+
+    try:
+        t = LocalhostTree(rank, n, port)
+        sgd = TreeAllReduceSGD(t)
+        params = {"w": np.zeros((8, 4), np.float64),
+                  "b": np.zeros((4,), np.float64)}
+        params = sgd.synchronize_parameters(params)
+        rng = np.random.RandomState(100 + rank)
+        for _ in range(3 + rank):        # UNEVEN step counts across ranks
+            grads = {"w": rng.randn(8, 4), "b": rng.randn(4)}
+            g, m = sgd.sum_and_normalize_gradients(grads)
+            params = {k: params[k] - 0.1 * g[k] for k in params}
+        params = sgd.synchronize_parameters(params)
+        t.close()
+        q.put(("ok", rank, _digest(params.values())))
+    except Exception as e:  # noqa: BLE001 — surface in parent
+        q.put(("err", rank, repr(e)))
+
+
+def _spmd_worker(pid: int, nprocs: int, port: int, q) -> None:
+    sys.path.insert(0, _REPO)
+    try:
+        from distlearn_tpu.parallel.init import (global_mesh_tree,
+                                                 host_local_batch, initialize)
+        info = initialize(f"127.0.0.1:{port}", nprocs, pid,
+                          local_device_count=2)
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import random
+
+        from distlearn_tpu.models.core import Model
+        from distlearn_tpu.train import build_sgd_step, init_train_state
+
+        def init(key):
+            k1, _ = random.split(key)
+            return {"w": random.normal(k1, (16, 10)) * 0.1,
+                    "b": jnp.zeros((10,))}, {}
+
+        def apply(params, state, x, train=True, rng=None, axis_name=None,
+                  bn_weight=None):
+            logits = x.reshape(x.shape[0], -1) @ params["w"] + params["b"]
+            return jax.nn.log_softmax(logits), state
+
+        model = Model(init=init, apply=apply, name="toy",
+                      input_shape=(4, 4, 1), num_classes=10)
+        tree = global_mesh_tree()
+        assert tree.num_nodes == info.global_devices == 2 * nprocs
+
+        ts = init_train_state(model, tree, random.PRNGKey(0), 10)
+        step = build_sgd_step(model, tree, lr=0.1)
+        rs = np.random.RandomState(7)
+        gx = rs.randn(8, 4, 4, 1).astype(np.float32)
+        gy = rs.randint(0, 10, (8,)).astype(np.int32)
+        per = 8 // info.num_processes            # this host's input shard
+        bx = host_local_batch(tree, gx[pid * per:(pid + 1) * per])
+        by = host_local_batch(tree, gy[pid * per:(pid + 1) * per])
+        for _ in range(3):
+            ts, loss = step(ts, bx, by)
+        leaves = [np.asarray(jax.device_get(l.addressable_shards[0].data))
+                  for l in jax.tree_util.tree_leaves(ts.params)]
+        q.put(("ok", pid, _digest(leaves),
+               float(loss.addressable_shards[0].data[()])))
+    except Exception as e:  # noqa: BLE001
+        q.put(("err", pid, repr(e)))
+
+
+def _run_spawned(target, n: int, port: int, timeout: float):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=target, args=(i, n, port, q))
+             for i in range(n)]
+    for p in procs:
+        p.start()
+    try:
+        results = [q.get(timeout=timeout) for _ in range(n)]
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    errs = [r for r in results if r[0] == "err"]
+    assert not errs, f"worker failures: {errs}"
+    return results
+
+
+def test_tcp_tree_training_across_processes():
+    port = reserve_port_window(1)
+    results = _run_spawned(_tcp_worker, 2, port, timeout=120)
+    digests = {r[2] for r in results}
+    assert len(digests) == 1, f"params diverged across hosts: {results}"
+
+
+def test_jax_distributed_global_mesh_spmd():
+    port = reserve_port_window(1)
+    results = _run_spawned(_spmd_worker, 2, port, timeout=300)
+    digests = {r[2] for r in results}
+    losses = {r[3] for r in results}
+    assert len(digests) == 1, f"params diverged across processes: {results}"
+    assert len(losses) == 1
