@@ -1,0 +1,34 @@
+"""Backend pinning helpers.
+
+Session environments may pre-import jax pinned to an attached TPU (a
+sitecustomize .pth hook), which makes ``JAX_PLATFORMS`` env vars a no-op;
+and ``XLA_FLAGS`` may already carry a stale
+``xla_force_host_platform_device_count``.  Every entry point that needs a
+virtual CPU mesh (tests, examples, bench probes, the driver's multichip
+dryrun) therefore needs the same two steps, centralized here: replace the
+flag, then force the platform through the config knob.  Call BEFORE any
+device query.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def set_host_device_count(n: int) -> None:
+    """Set ``--xla_force_host_platform_device_count=n``, replacing any
+    existing value (a pre-set flag must not silently override the caller's
+    requested count)."""
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def force_cpu(num_devices: int | None = None) -> None:
+    """Pin the CPU backend (reliably, via the config knob), optionally with
+    ``num_devices`` virtual devices."""
+    if num_devices is not None:
+        set_host_device_count(num_devices)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
