@@ -14,7 +14,7 @@ Run:  python examples/cifar10.py --numNodes 4 --batchSize 128 [--tpu]
 
 from __future__ import annotations
 
-from common import setup_platform, device_stream
+from common import setup_platform, resolve_num_nodes, device_stream
 from distlearn_tpu.utils.flags import parse_flags, NODE_FLAGS, TRAIN_FLAGS
 
 
@@ -29,6 +29,10 @@ def main():
         "save": ("", "checkpoint dir (empty = off)"),
         "resume": (False, "resume from newest checkpoint in --save"),
         "bf16": (False, "bfloat16 compute (MXU path)"),
+        "testData": ("", "path to a test-split .npz (tools/make_npz.py "
+                         "emits one; default: last 10% of --data)"),
+        "parity": (False, "print a final JSON accuracy line "
+                          "(BASELINE.md accuracy-parity harness)"),
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -51,14 +55,17 @@ def main():
     from distlearn_tpu.utils.profiling import StepTimer
 
     log = root_print(0)
-    tree = MeshTree(num_nodes=opt.numNodes)
+    tree = MeshTree(num_nodes=resolve_num_nodes(opt.numNodes, opt.tpu))
     log(f"mesh: {tree.num_nodes} nodes on {jax.devices()[0].platform}")
 
     if opt.data:
         x, y, nc = load_npz(opt.data)
-        n_test = max(1, len(y) // 10)
-        xte, yte = x[-n_test:], y[-n_test:]
-        x, y = x[:-n_test], y[:-n_test]
+        if opt.testData:
+            xte, yte, _ = load_npz(opt.testData)
+        else:
+            n_test = max(1, len(y) // 10)
+            xte, yte = x[-n_test:], y[-n_test:]
+            x, y = x[:-n_test], y[:-n_test]
     else:
         x, y, nc = synthetic_cifar10(opt.numExamples, seed=opt.seed)
         xte, yte, _ = synthetic_cifar10(opt.testExamples, seed=opt.seed + 1)
@@ -82,6 +89,10 @@ def main():
         log(f"resumed from epoch {meta['step']}")
 
     timer = StepTimer()
+    # pre-bind report state: --parity must emit a line even for a zero-epoch
+    # run (e.g. --resume after training already completed)
+    train_cm = reduce_confusion(ts.cm)
+    cm = jnp.zeros_like(ts.cm)
     for epoch in range(start_epoch, opt.numEpochs + 1):
         sampler = LabelUniformSampler(ds.y, seed=opt.seed + epoch)
         for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
@@ -108,6 +119,16 @@ def main():
                 {"params": ts.params, "model_state": ts.model_state},
                 metadata={"epoch": epoch})
     jax.block_until_ready(ts.params)
+    if opt.parity:
+        # One machine-readable line for the parity table (docs/PARITY.md).
+        import json
+        print(json.dumps({
+            "example": "cifar10", "epochs": opt.numEpochs,
+            "data": "npz" if opt.data else "synthetic",
+            "global_batch": opt.batchSize, "nodes": tree.num_nodes,
+            "train_acc": round(M.total_valid(train_cm), 4),
+            "test_acc": round(M.total_valid(reduce_confusion(cm)), 4),
+        }))
     log("done")
 
 

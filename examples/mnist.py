@@ -13,7 +13,7 @@ Run:  python examples/mnist.py --numNodes 4 [--tpu] [--data mnist.npz]
 
 from __future__ import annotations
 
-from common import setup_platform, device_stream
+from common import setup_platform, resolve_num_nodes, device_stream
 from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS)
 
 
@@ -25,6 +25,8 @@ def main():
         "data": ("", "path to .npz with x [N,32,32,1]/y (default: synthetic)"),
         "numExamples": (4096, "synthetic dataset size"),
         "reportEvery": (100, "steps between confusion-matrix reports"),
+        "parity": (False, "print a final JSON accuracy line "
+                          "(BASELINE.md accuracy-parity harness)"),
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -43,7 +45,7 @@ def main():
     from distlearn_tpu.utils.profiling import StepTimer
 
     log = root_print(0)
-    tree = MeshTree(num_nodes=opt.numNodes)
+    tree = MeshTree(num_nodes=resolve_num_nodes(opt.numNodes, opt.tpu))
     log(f"mesh: {tree.num_nodes} nodes on {jax.devices()[0].platform}")
 
     if opt.data:
@@ -59,6 +61,7 @@ def main():
 
     timer = StepTimer()
     global_step = 0
+    final_acc = 0.0
     for epoch in range(1, opt.numEpochs + 1):
         sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
         for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
@@ -73,8 +76,17 @@ def main():
         cm = reduce_confusion(ts.cm)
         log(f"epoch {epoch}: {M.format_confusion(cm)} "
             f"({timer.steps_per_sec():.1f} steps/s)")
+        final_acc = M.total_valid(cm)
         ts = ts._replace(cm=jax.tree_util.tree_map(lambda c: c * 0, ts.cm))
     jax.block_until_ready(ts.params)
+    if opt.parity:
+        import json
+        print(json.dumps({
+            "example": "mnist", "epochs": opt.numEpochs,
+            "data": "npz" if opt.data else "synthetic",
+            "global_batch": opt.batchSize, "nodes": tree.num_nodes,
+            "train_acc": round(final_acc, 4),
+        }))
     log("done")
 
 

@@ -70,3 +70,9 @@ def test_synthetic_learnable_signal():
     within = np.corrcoef(c0[0], c0[1])[0, 1]
     across = np.corrcoef(c0[0], c1[0])[0, 1]
     assert within > across
+
+
+def test_synthetic_non_multiple_of_four_size():
+    from distlearn_tpu.data import synthetic_imagenet
+    x, y, nc = synthetic_imagenet(4, image_size=30, num_classes=7)
+    assert x.shape == (4, 30, 30, 3) and nc == 7

@@ -1,0 +1,78 @@
+"""tools/make_npz.py — converter tests (fake raw dumps -> npz schema)."""
+
+import gzip
+import os
+import pickle
+import struct
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import make_npz  # noqa: E402
+
+from distlearn_tpu.data import load_npz  # noqa: E402
+
+
+def _write_idx_images(path, images: np.ndarray, gz=False):
+    header = struct.pack(">IIII", 0x00000803, *images.shape)
+    opener = gzip.open if gz else open
+    with opener(path + (".gz" if gz else ""), "wb") as fh:
+        fh.write(header + images.tobytes())
+
+
+def _write_idx_labels(path, labels: np.ndarray):
+    with open(path, "wb") as fh:
+        fh.write(struct.pack(">II", 0x00000801, len(labels)) + labels.tobytes())
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (12, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, 12).astype(np.uint8)
+    # train as .gz (converter must accept both), test as raw
+    _write_idx_images(str(tmp_path / "train-images-idx3-ubyte"), imgs, gz=True)
+    _write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte"), labels)
+    _write_idx_images(str(tmp_path / "t10k-images-idx3-ubyte"), imgs[:5])
+    _write_idx_labels(str(tmp_path / "t10k-labels-idx1-ubyte"), labels[:5])
+
+    out = str(tmp_path / "mnist.npz")
+    assert make_npz.main(["mnist", str(tmp_path), "-o", out]) == 0
+    x, y, nc = load_npz(out)
+    assert x.shape == (12, 32, 32, 1) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    np.testing.assert_array_equal(x[:, 2:30, 2:30, 0],
+                                  imgs.astype(np.float32) / 255.0)
+    np.testing.assert_array_equal(y, labels.astype(np.int32))
+    xt, yt, _ = load_npz(str(tmp_path / "mnist_test.npz"))
+    assert xt.shape == (5, 32, 32, 1) and len(yt) == 5
+
+
+def test_cifar10_pickle_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    per = 4
+    all_data, all_labels = [], []
+    for i in range(1, 6):
+        data = rng.randint(0, 256, (per, 3 * 32 * 32)).astype(np.uint8)
+        labels = rng.randint(0, 10, per).tolist()
+        with open(d / f"data_batch_{i}", "wb") as fh:
+            pickle.dump({b"data": data, b"labels": labels}, fh)
+        all_data.append(data)
+        all_labels += labels
+    with open(d / "test_batch", "wb") as fh:
+        pickle.dump({b"data": all_data[0], b"labels": all_labels[:per]}, fh)
+
+    out = str(tmp_path / "cifar10.npz")
+    assert make_npz.main(["cifar10", str(tmp_path), "-o", out]) == 0
+    x, y, nc = load_npz(out)
+    assert x.shape == (20, 32, 32, 3) and x.dtype == np.float32
+    np.testing.assert_array_equal(y, np.asarray(all_labels, np.int32))
+    # channel layout: pickles are CHW-flat; npz must be NHWC
+    ref = all_data[0].reshape(per, 3, 32, 32).transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(x[:per], ref.astype(np.float32) / 255.0)
+    xt, yt, _ = load_npz(str(tmp_path / "cifar10_test.npz"))
+    assert xt.shape == (per, 32, 32, 3)

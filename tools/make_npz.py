@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Convert standard MNIST / CIFAR-10 dumps to the framework's .npz schema.
+
+The reference trains on real MNIST/CIFAR fetched by torch-dataset from
+$HOME-prefixed files (examples/mnist.lua:26-29, examples/Data.lua:7-8).
+This environment has no egress, so the examples default to synthetic data;
+when real dumps ARE present, this converter produces the `.npz` files the
+examples' ``--data`` flag consumes, enabling the accuracy-parity run
+(BASELINE.md "accuracy parity").
+
+npz schema (what ``distlearn_tpu.data.load_npz`` reads):
+    x : float32 [N, H, W, C]  — NHWC, values in [0, 1]
+    y : int32   [N]           — class labels 0..9
+
+Supported inputs (all offline formats):
+
+* MNIST IDX (`python tools/make_npz.py mnist DIR -o mnist.npz`):
+  ``train-images-idx3-ubyte[.gz]`` + ``train-labels-idx1-ubyte[.gz]``
+  (and ``t10k-*`` for the test split).  Images are zero-padded 28x28 ->
+  32x32, matching the 32x32 layout the reference trains on
+  (examples/mnist.lua:53 reshapes to 1x32x32).
+* CIFAR-10 python batches (`python tools/make_npz.py cifar10 DIR`):
+  ``cifar-10-batches-py/data_batch_1..5`` + ``test_batch`` pickles.
+
+Each run writes ``<out>`` (train) and ``<out stem>_test.npz`` (test).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import os
+import pickle
+import struct
+import sys
+
+import numpy as np
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path):
+        return open(path, "rb")
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    raise FileNotFoundError(f"{path}[.gz] not found")
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (the MNIST wire format: magic, dims, raw bytes)."""
+    with _open_maybe_gz(path) as fh:
+        magic = struct.unpack(">I", fh.read(4))[0]
+        dtype_code, ndim = (magic >> 8) & 0xFF, magic & 0xFF
+        if dtype_code != 0x08:
+            raise ValueError(f"{path}: only ubyte IDX supported, got "
+                             f"type 0x{dtype_code:02x}")
+        shape = struct.unpack(f">{ndim}I", fh.read(4 * ndim))
+        data = np.frombuffer(fh.read(), dtype=np.uint8)
+    return data.reshape(shape)
+
+
+def convert_mnist(src: str, split: str) -> tuple[np.ndarray, np.ndarray]:
+    prefix = "train" if split == "train" else "t10k"
+    images = _read_idx(os.path.join(src, f"{prefix}-images-idx3-ubyte"))
+    labels = _read_idx(os.path.join(src, f"{prefix}-labels-idx1-ubyte"))
+    if len(images) != len(labels):
+        raise ValueError(f"{len(images)} images vs {len(labels)} labels")
+    x = np.zeros((len(images), 32, 32, 1), np.float32)
+    x[:, 2:30, 2:30, 0] = images.astype(np.float32) / 255.0   # pad 28->32
+    return x, labels.astype(np.int32)
+
+
+def convert_cifar10(src: str, split: str) -> tuple[np.ndarray, np.ndarray]:
+    d = os.path.join(src, "cifar-10-batches-py")
+    if not os.path.isdir(d):
+        d = src
+    names = [f"data_batch_{i}" for i in range(1, 6)] if split == "train" \
+        else ["test_batch"]
+    xs, ys = [], []
+    for name in names:
+        with _open_maybe_gz(os.path.join(d, name)) as fh:
+            batch = pickle.load(fh, encoding="bytes")
+        xs.append(np.asarray(batch[b"data"], np.uint8))
+        ys.append(np.asarray(batch[b"labels"], np.int64))
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32)     # CHW in the pickles
+    x = x.transpose(0, 2, 3, 1).astype(np.float32) / 255.0   # -> NHWC
+    return x, np.concatenate(ys).astype(np.int32)
+
+
+_CONVERTERS = {"mnist": convert_mnist, "cifar10": convert_cifar10}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("dataset", choices=sorted(_CONVERTERS))
+    p.add_argument("src", help="directory holding the raw dump")
+    p.add_argument("-o", "--out", default="",
+                   help="output .npz (default: <dataset>.npz)")
+    args = p.parse_args(argv)
+    out = args.out or f"{args.dataset}.npz"
+    stem, ext = os.path.splitext(out)
+    conv = _CONVERTERS[args.dataset]
+
+    x, y = conv(args.src, "train")
+    np.savez_compressed(out, x=x, y=y)
+    print(f"wrote {out}: x {x.shape} {x.dtype}, y {y.shape} "
+          f"({len(np.unique(y))} classes)")
+    try:
+        xt, yt = conv(args.src, "test")
+    except FileNotFoundError as e:
+        print(f"no test split converted ({e})", file=sys.stderr)
+        return 0
+    np.savez_compressed(f"{stem}_test{ext}", x=xt, y=yt)
+    print(f"wrote {stem}_test{ext}: x {xt.shape}, y {yt.shape}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
