@@ -14,7 +14,7 @@ Run:  python examples/resnet50.py --numNodes 8 --batchSize 256
 
 from __future__ import annotations
 
-from common import setup_platform, device_stream
+from common import setup_platform, resolve_num_nodes, device_stream
 from distlearn_tpu.utils.flags import parse_flags, NODE_FLAGS, TRAIN_FLAGS
 
 
@@ -51,7 +51,7 @@ def main():
     from distlearn_tpu.utils.profiling import StepTimer
 
     log = root_print(0)
-    tree = MeshTree(num_nodes=opt.numNodes)
+    tree = MeshTree(num_nodes=resolve_num_nodes(opt.numNodes, opt.tpu))
     log(f"mesh: {tree.num_nodes} nodes on {jax.devices()[0].platform}")
 
     if opt.data:
