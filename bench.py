@@ -304,6 +304,53 @@ def bench_resnet50(batch: int, iters: int, windows: int, peak):
     }
 
 
+def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
+                         peak):
+    """Long-context transformer LM utilization bench: the fused LM train
+    step (next-token loss, full backward, SGD) on one chip, bf16 compute.
+    On a pod the same step shards over (data, seq, model) axes — see
+    distlearn_tpu.train.lm; this measures the per-chip compute story."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train.lm import build_lm_step
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs[:1]).reshape(1, 1, 1),
+                ("data", "seq", "model"))
+    lm = transformer_lm(vocab=32768, dim=512, depth=8, heads=8, max_len=seq,
+                        compute_dtype=jnp.bfloat16)
+    params, _ = lm.init(random.PRNGKey(0))
+    step = build_lm_step(lm, mesh, params, lr=1e-2)
+    tokens = jax.device_put(
+        np.random.RandomState(0).randint(0, 32768, (batch, seq))
+        .astype(np.int32),
+        NamedSharding(mesh, P("data", "seq")))
+
+    flops = step_flops(step, params, tokens)
+    state = {"p": params}
+
+    def run(n):
+        p = state["p"]
+        for _ in range(n):
+            p, loss = step(p, tokens)
+        state["p"] = p
+        state["loss"] = float(jax.device_get(loss))
+
+    med, times = timed_windows(lambda: run(iters), lambda: run(5), windows)
+    sps = iters / med
+    mfu = check_mfu("transformer_lm", flops, sps, peak)
+    return {
+        "batch": batch, "seq_len": seq, "steps_per_sec": sps,
+        "tokens_per_sec": sps * batch * seq, "flops_per_step": flops,
+        "mfu": mfu, "window_times": times, "final_loss": state["loss"],
+    }
+
+
 def main():
     _enable_compile_cache()
     batch = int(os.environ.get("BENCH_BATCH", "256"))
@@ -371,6 +418,24 @@ def main():
             raise
         except Exception as e:  # noqa: BLE001 — OOM etc must not kill bench
             print(f"[bench] resnet50 bench failed: {e}", file=sys.stderr)
+
+    # --- transformer LM (long-context) utilization bench --------------------
+    if os.environ.get("BENCH_SKIP_LM") != "1" and platform == "tpu":
+        lb = int(os.environ.get("BENCH_LM_BATCH", "8"))
+        ls = int(os.environ.get("BENCH_LM_SEQ", "1024"))
+        li = int(os.environ.get("BENCH_LM_ITERS", "30"))
+        try:
+            details["transformer_lm"] = bench_transformer_lm(lb, ls, li, 3,
+                                                             peak)
+            t = details["transformer_lm"]
+            print(f"[bench] transformer_lm batch={lb} seq={ls}: "
+                  f"{t['tokens_per_sec']:.0f} tok/s"
+                  + (f", MFU={t['mfu']:.4f}" if t["mfu"] is not None else ""),
+                  file=sys.stderr)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] transformer_lm bench failed: {e}", file=sys.stderr)
 
     # --- modeled baseline ---------------------------------------------------
     baseline = (sps if platform == "cpu"

@@ -30,7 +30,8 @@ from jax import lax, random
 from jax.sharding import PartitionSpec as P
 
 from distlearn_tpu.models.core import Model
-from distlearn_tpu.parallel.sequence import local_attention, ring_attention
+from distlearn_tpu.parallel.sequence import (alltoall_attention,
+                                             local_attention, ring_attention)
 from distlearn_tpu.parallel.tp import tp_enter, tp_reduce
 
 PyTree = Any
@@ -48,13 +49,21 @@ def _rmsnorm(params, x, eps=1e-6):
 
 def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
-                   dtype=jnp.float32, compute_dtype=None) -> Model:
+                   dtype=jnp.float32, compute_dtype=None,
+                   seq_impl: str = "ring") -> Model:
     """Returns a :class:`Model` whose ``apply(params, state, tokens, ...)``
     maps int tokens [B, L_local] -> next-token logits [B, L_local, vocab].
 
     ``axis_name`` (data axis) is unused here; sequence and tensor axes are
-    passed per-call via ``seq_axis`` / ``tp_axis`` keywords.
+    passed per-call via ``seq_axis`` / ``tp_axis`` keywords.  ``seq_impl``
+    picks the sequence-parallel attention: ``"ring"`` (neighbor-hop K/V
+    rotation, unbounded L) or ``"alltoall"`` (Ulysses head-scatter — needs
+    heads divisible by the seq axis and the full score block in memory).
     """
+    if seq_impl not in ("ring", "alltoall"):
+        raise ValueError(f"seq_impl must be 'ring' or 'alltoall', "
+                         f"got {seq_impl!r}")
+    seq_attn = ring_attention if seq_impl == "ring" else alltoall_attention
     head_dim = dim // heads
     hidden = dim * mlp_ratio
     cd = compute_dtype or dtype
@@ -103,7 +112,7 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
             k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
             v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
             if seq_axis is not None:
-                att = ring_attention(q, k, v, seq_axis, causal=True)
+                att = seq_attn(q, k, v, seq_axis, causal=True)
             else:
                 att = local_attention(q, k, v, causal=True)
             proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))

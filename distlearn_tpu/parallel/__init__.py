@@ -5,7 +5,9 @@ from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
 from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
                                              AsyncEATester)
-from distlearn_tpu.parallel.sequence import ring_attention, local_attention
+from distlearn_tpu.parallel.sequence import (ring_attention, local_attention,
+                                             alltoall_attention)
+from distlearn_tpu.parallel.pp import pipeline_apply
 from distlearn_tpu.parallel.host_algorithms import (TreeAllReduceSGD,
                                                     TreeAllReduceEA)
 
@@ -21,6 +23,8 @@ __all__ = [
     "AsyncEATester",
     "ring_attention",
     "local_attention",
+    "alltoall_attention",
+    "pipeline_apply",
     "TreeAllReduceSGD",
     "TreeAllReduceEA",
 ]

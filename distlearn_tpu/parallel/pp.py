@@ -1,0 +1,96 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+Absent from the reference (single-process forward/backward per node —
+SURVEY.md §2c), provided here as a first-class mesh dimension alongside
+data/sequence/tensor parallelism: stage parameters are sharded over a
+``pipe`` axis (one stage per device), microbatches stream through the
+stages, and the inter-stage hop is a neighbor ``ppermute`` riding one ICI
+link.  The whole pipeline — all ticks, all stages — is ONE ``lax.scan``
+inside one jitted shard_map program, so XLA overlaps each tick's compute
+with the neighbor transfer, and ``jax.grad`` through the scan yields the
+standard GPipe backward schedule for free (functional autodiff replaces the
+hand-written backward pipelines of imperative frameworks).
+
+Schedule: ``M`` microbatches over ``S`` stages take ``M + S - 1`` ticks;
+bubble fraction ``(S-1)/(M+S-1)`` — choose ``M >> S`` to amortize.
+
+SPMD shape: every device runs the same program; at tick ``t`` stage 0
+ingests microbatch ``t`` (or zeros once input is exhausted) while stages
+``1..S-1`` consume the activation ppermuted from their predecessor.  The
+last stage's valid outputs are broadcast back to all stages (psum-masked,
+like :func:`distlearn_tpu.parallel.mesh.broadcast_from`), keeping the
+caller's output replicated over the pipe axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
+                   num_microbatches: int, axis_name: str = "pipe"
+                   ) -> jax.Array:
+    """Run ``x`` through ``S`` pipelined stages (``S`` = size of
+    ``axis_name``).
+
+    Args:
+      stage_fn: ``(params, h) -> h`` — ONE stage's transform.  Must map a
+        microbatch ``[mb, ...]`` to the same shape (inter-stage activations
+        are homogeneous, the usual pipeline restriction).
+      stage_params: THIS device's stage parameters (caller shards a stacked
+        ``[S, ...]`` pytree over the pipe axis and squeezes, exactly like
+        the per-node state in distlearn_tpu.train).
+      x: the full local batch ``[B, ...]`` (replicated over the pipe axis);
+        ``B`` must divide into ``num_microbatches`` equal microbatches.
+      num_microbatches: GPipe ``M``; bubble = (S-1)/(M+S-1).
+
+    Returns: ``[B, ...]`` outputs of the LAST stage, replicated over the
+    pipe axis (differentiable end to end).
+    """
+    S = lax.psum(1, axis_name)          # static under shard_map
+    idx = lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    mb = B // M
+    mbs = x.reshape((M, mb) + x.shape[1:])
+    T = M + S - 1
+
+    # Probe the stage output type (abstract — no FLOPs run): the scan carry
+    # must be well-typed, and pipelining requires homogeneous activations.
+    out_aval = jax.eval_shape(stage_fn, stage_params, mbs[0])
+    if out_aval.shape != mbs[0].shape:
+        raise ValueError(
+            f"stage_fn must preserve activation shape (got {mbs[0].shape} "
+            f"-> {out_aval.shape}); wrap in/out projections around the "
+            "pipeline, not inside it")
+    zeros_state = jnp.zeros(out_aval.shape, out_aval.dtype)
+
+    fwd_perm = [(j, j + 1) for j in range(S - 1)]   # no wraparound
+
+    def tick(state, t):
+        # stage 0 ingests microbatch t (zeros once exhausted); others take
+        # the activation their predecessor ppermuted last tick
+        feed = lax.dynamic_index_in_dim(mbs, jnp.minimum(t, M - 1), 0,
+                                        keepdims=False)
+        feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
+        h = jnp.where(idx == 0, feed.astype(zeros_state.dtype), state)
+        out = stage_fn(stage_params, h)
+        nxt = lax.ppermute(out, axis_name, fwd_perm)
+        return nxt, out
+
+    _, outs = lax.scan(tick, zeros_state, jnp.arange(T))   # [T, mb, ...]
+
+    # The last stage's outputs at ticks S-1 .. T-1 are microbatches 0..M-1.
+    valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
+    y = valid.reshape((B,) + valid.shape[2:])
+    # broadcast from the last stage so every device returns the result
+    mask = (idx == S - 1)
+    return lax.psum(jnp.where(mask, y, jnp.zeros_like(y)), axis_name)

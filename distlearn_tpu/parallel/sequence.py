@@ -94,6 +94,42 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
+def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                       axis_name: str, causal: bool = False) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Two ``all_to_all`` collectives swap the SEQUENCE sharding for a HEAD
+    sharding: each device then holds the FULL sequence for ``H/n`` of the
+    heads, runs ordinary full-attention locally, and swaps back.  Compared
+    to :func:`ring_attention` (n-1 neighbor hops, never materializes the
+    full sequence): total bytes moved are lower (two all-to-alls of the
+    activations vs rotating K/V n-1 times), but the full ``L x L`` score
+    block must fit in memory and the head count must be divisible by the
+    axis size — the standard trade; both variants are first-class.
+
+    q/k/v: local shards ``[B, L_local, H, D]`` (global sequence = rank-order
+    concatenation over the axis).  Returns ``[B, L_local, H, D]``.
+    """
+    n = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(
+            f"alltoall_attention needs head count divisible by the "
+            f"sequence-axis size, got {H} heads over {n} devices; use "
+            "ring_attention for this configuration")
+
+    def seq_to_heads(x):
+        # [B, L_loc, H, D] -> [B, L, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    out = local_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+                          causal=causal)        # full-sequence, local heads
+    # [B, L, H/n, D] -> [B, L_loc, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
 def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False) -> jax.Array:
     """Single-device reference attention (same layout), for tests and

@@ -1,0 +1,98 @@
+"""Pipeline parallelism: the GPipe microbatch pipeline must match running
+the stages sequentially on one device, forward AND backward (jax.grad
+through the scan is the pipeline backward schedule)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distlearn_tpu.parallel.pp import pipeline_apply
+
+DIM = 8
+
+
+def _stage(params, h):
+    return jnp.tanh(h @ params["w"] + params["b"])
+
+
+def _sequential(stacked, x):
+    h = x
+    for s in range(stacked["w"].shape[0]):
+        h = _stage({"w": stacked["w"][s], "b": stacked["b"][s]}, h)
+    return h
+
+
+def _stacked_params(S, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(S, DIM, DIM).astype(np.float32) * 0.5),
+            "b": jnp.asarray(rng.randn(S, DIM).astype(np.float32) * 0.1)}
+
+
+def _pipeline_fn(mesh, M):
+    def fn(stacked, x):
+        local = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0), stacked)
+        return pipeline_apply(_stage, local, x, M, axis_name="pipe")
+    return jax.jit(jax.shard_map(fn, mesh=mesh,
+                                 in_specs=(P("pipe"), P()),
+                                 out_specs=P(), check_vma=False))
+
+
+@pytest.mark.parametrize("S,M", [(2, 8), (4, 4), (4, 8), (8, 2)])
+def test_pipeline_matches_sequential(S, M):
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+    stacked = _stacked_params(S)
+    x = jnp.asarray(np.random.RandomState(1).randn(16, DIM)
+                    .astype(np.float32))
+    out = _pipeline_fn(mesh, M)(stacked, x)
+    ref = _sequential(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_match_sequential():
+    S, M = 4, 4
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+    stacked = _stacked_params(S, seed=2)
+    x = jnp.asarray(np.random.RandomState(3).randn(8, DIM)
+                    .astype(np.float32))
+    pipe = _pipeline_fn(mesh, M)
+
+    g_pipe = jax.grad(lambda p: jnp.sum(pipe(p, x) ** 2))(stacked)
+    g_ref = jax.grad(lambda p: jnp.sum(_sequential(p, x) ** 2))(stacked)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_rejects_shape_changing_stage():
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+    stacked = {"w": jnp.zeros((2, DIM, DIM + 1))}
+
+    def bad_stage(params, h):
+        return h @ params["w"]
+
+    def fn(st, x):
+        local = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0), st)
+        return pipeline_apply(bad_stage, local, x, 2, axis_name="pipe")
+
+    with pytest.raises(ValueError, match="preserve activation shape"):
+        jax.shard_map(fn, mesh=mesh, in_specs=(P("pipe"), P()),
+                      out_specs=P(), check_vma=False)(
+            stacked, jnp.zeros((4, DIM)))
+
+
+def test_pipeline_rejects_indivisible_microbatches():
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pipe",))
+    stacked = _stacked_params(2)
+
+    def fn(st, x):
+        local = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0), st)
+        return pipeline_apply(_stage, local, x, 3, axis_name="pipe")
+
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.shard_map(fn, mesh=mesh, in_specs=(P("pipe"), P()),
+                      out_specs=P(), check_vma=False)(
+            stacked, jnp.zeros((8, DIM)))
