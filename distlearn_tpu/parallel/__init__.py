@@ -8,6 +8,7 @@ from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
 from distlearn_tpu.parallel.sequence import (ring_attention, local_attention,
                                              alltoall_attention)
 from distlearn_tpu.parallel.pp import pipeline_apply
+from distlearn_tpu.parallel.ep import moe_ffn, route_top1
 from distlearn_tpu.parallel.host_algorithms import (TreeAllReduceSGD,
                                                     TreeAllReduceEA)
 
@@ -25,6 +26,8 @@ __all__ = [
     "local_attention",
     "alltoall_attention",
     "pipeline_apply",
+    "moe_ffn",
+    "route_top1",
     "TreeAllReduceSGD",
     "TreeAllReduceEA",
 ]

@@ -1,0 +1,99 @@
+"""Expert parallelism: a routed mixture-of-experts layer over a mesh axis.
+
+Absent from the reference (SURVEY.md §2c lists EP as explicitly out of its
+scope), provided as the last of the framework's first-class mesh
+dimensions (data / sequence / tensor / pipeline / expert).  The design is
+the GShard/Switch pattern expressed TPU-natively:
+
+* **Routing** (per device, local tokens): a linear router picks each
+  token's top-1 expert; tokens beyond an expert's capacity are dropped
+  (their combine weight is zero — output falls back to the residual
+  stream, the standard Switch behavior).
+* **Dispatch/combine as einsums**: boolean dispatch mask ``[N, E, C]`` and
+  float combine weights ``[N, E, C]`` turn gather/scatter into two MXU
+  einsums — no dynamic shapes, no sorting, XLA-friendly.
+* **All-to-all over the expert axis**: each device owns ONE expert; the
+  dispatched buckets ``[E, C, D]`` are exchanged so device ``e`` receives
+  every peer's bucket for expert ``e``, applies its expert FFN to
+  ``E*C`` tokens in one batched matmul, and the reverse all-to-all routes
+  results home.  Both hops ride ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def route_top1(router_logits: jax.Array, capacity: int
+               ) -> tuple[jax.Array, jax.Array]:
+    """Top-1 routing with capacity.
+
+    Args:
+      router_logits: ``[N, E]`` raw router scores for local tokens.
+      capacity: per-expert bucket size ``C``.
+
+    Returns ``(dispatch, combine)``: dispatch ``[N, E, C]`` bool — token n
+    goes to slot c of expert e; combine ``[N, E, C]`` float32 — softmax
+    gate weight at the same coordinates (zero for dropped tokens).
+    """
+    N, E = router_logits.shape
+    gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(gates, axis=-1)                     # [N]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)     # [N, E]
+    # position of each token within its expert's bucket (0-based)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1           # [N, E]
+    dispatch = (onehot > 0) & (pos < capacity)              # [N, E] kept?
+    slot = jax.nn.one_hot(jnp.where(dispatch, pos, -1), capacity,
+                          dtype=jnp.bool_)                  # [N, E, C]
+    dispatch3 = slot & dispatch[..., None]
+    gate = jnp.max(gates * onehot, axis=-1)                 # [N] top-1 weight
+    combine = dispatch3.astype(jnp.float32) * gate[:, None, None]
+    return dispatch3, combine
+
+
+def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
+            x: jax.Array, capacity_factor: float = 1.25,
+            axis_name: str = "expert") -> jax.Array:
+    """Expert-parallel mixture-of-experts FFN (one expert per device).
+
+    Args:
+      expert_fn: ``(params, tokens) -> tokens`` — THIS device's expert,
+        applied to a ``[E*C, D]`` batch of dispatched tokens.
+      expert_params: this device's expert parameters (caller shards a
+        stacked ``[E, ...]`` pytree over ``axis_name`` and squeezes).
+      router_w: ``[D, E]`` router weights (replicated — every device must
+        route identically).
+      x: local tokens ``[N, D]`` (flatten batch/sequence first).
+      capacity_factor: bucket size ``C = ceil(N / E * factor)``.
+
+    Returns ``[N, D]``: gate-weighted expert outputs; capacity-dropped
+    tokens contribute zeros (add the residual stream outside).
+    """
+    E = lax.psum(1, axis_name)
+    N, D = x.shape
+    if router_w.shape != (D, E):
+        raise ValueError(
+            f"router_w must be [{D}, {E}] (token dim x expert-axis size, "
+            f"one expert per device), got {router_w.shape}")
+    capacity = max(1, int(-(-N * capacity_factor // E)))
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # [N, E]
+    dispatch, combine = route_top1(logits, capacity)
+
+    # gather tokens into per-expert buckets: [E, C, D]
+    buckets = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    # all-to-all: device e receives every peer's bucket for expert e,
+    # stacked along a peer axis -> [E_peers, C, D] -> one batched FFN call
+    recv = lax.all_to_all(buckets, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                       # [E*C, ...] rows
+    out = expert_fn(expert_params, recv.reshape(E * capacity, D))
+    out = out.reshape(E, capacity, D)
+    # reverse hop: peers get their tokens back at the same coordinates
+    home = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                       # [E, C, D]
+    return jnp.einsum("nec,ecd->nd", combine.astype(home.dtype), home)

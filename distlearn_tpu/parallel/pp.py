@@ -92,5 +92,5 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
     valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)
     y = valid.reshape((B,) + valid.shape[2:])
     # broadcast from the last stage so every device returns the result
-    mask = (idx == S - 1)
-    return lax.psum(jnp.where(mask, y, jnp.zeros_like(y)), axis_name)
+    from distlearn_tpu.parallel.mesh import broadcast_from
+    return broadcast_from(y, S - 1, axis_name)
