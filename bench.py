@@ -322,8 +322,13 @@ def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
     devs = jax.devices()
     mesh = Mesh(np.asarray(devs[:1]).reshape(1, 1, 1),
                 ("data", "seq", "model"))
-    lm = transformer_lm(vocab=32768, dim=512, depth=8, heads=8, max_len=seq,
-                        compute_dtype=jnp.bfloat16)
+    dim = int(os.environ.get("BENCH_LM_DIM", "1024"))
+    depth = int(os.environ.get("BENCH_LM_DEPTH", "8"))
+    if dim < 64 or dim % 64:
+        raise ValueError(f"BENCH_LM_DIM must be a multiple of 64 "
+                         f"(64-dim heads), got {dim}")
+    lm = transformer_lm(vocab=32768, dim=dim, depth=depth, heads=dim // 64,
+                        max_len=seq, compute_dtype=jnp.bfloat16)
     params, _ = lm.init(random.PRNGKey(0))
     step = build_lm_step(lm, mesh, params, lr=1e-2)
     tokens = jax.device_put(
@@ -345,7 +350,8 @@ def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
     sps = iters / med
     mfu = check_mfu("transformer_lm", flops, sps, peak)
     return {
-        "batch": batch, "seq_len": seq, "steps_per_sec": sps,
+        "batch": batch, "seq_len": seq, "dim": dim, "depth": depth,
+        "steps_per_sec": sps,
         "tokens_per_sec": sps * batch * seq, "flops_per_step": flops,
         "mfu": mfu, "window_times": times, "final_loss": state["loss"],
     }
@@ -405,7 +411,7 @@ def main():
 
     # --- ResNet-50 utilization bench ---------------------------------------
     if os.environ.get("BENCH_SKIP_RESNET") != "1" and platform == "tpu":
-        rb = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
+        rb = int(os.environ.get("BENCH_RESNET_BATCH", "256"))
         ri = int(os.environ.get("BENCH_RESNET_ITERS", "30"))
         try:
             details["resnet50"] = bench_resnet50(rb, ri, 3, peak)

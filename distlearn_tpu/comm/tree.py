@@ -75,7 +75,8 @@ class Tree:
     def __init__(self, rank: int, num_nodes: int, host: str, port: int,
                  base: int = 2, timeout: float = 60.0,
                  listen_host: str | None = None,
-                 advertise_host: str | None = None):
+                 advertise_host: str | None = None,
+                 op_timeout: float | None = None):
         """``host``/``port``: the coordinator (rank 0) address every rank
         dials for bootstrap.  Multi-host ranks must also say where THEY can
         be reached: ``listen_host`` is the local bind address for this rank's
@@ -83,7 +84,15 @@ class Tree:
         it, e.g. localhost; use ``"0.0.0.0"`` on a multi-host deployment) and
         ``advertise_host`` is the address other ranks should dial to reach
         this rank (default: ``listen_host`` if set and routable, else
-        ``host``)."""
+        ``host``).
+
+        ``op_timeout``: failure detection for collectives.  The reference
+        blocks forever when a node dies mid-reduce (SURVEY.md §5 "a dead
+        node hangs the tree"); with ``op_timeout`` set, any collective that
+        waits longer than this many seconds on one peer raises
+        :class:`TimeoutError` instead of wedging the job.  ``None`` keeps
+        the reference's block-forever semantics (collectives may
+        legitimately wait on slow ranks)."""
         if not 0 <= rank < num_nodes:
             raise ValueError(f"rank {rank} out of range for {num_nodes} nodes")
         if base < 1:
@@ -140,6 +149,13 @@ class Tree:
                 hello = conn.recv_msg()
                 by_rank[int(hello["child"])] = conn
             self._kids = [by_rank[r] for r in sorted(by_rank)]
+        self.set_op_timeout(op_timeout)
+
+    def set_op_timeout(self, seconds: float | None):
+        """(Re)arm failure detection on every tree link (see ``op_timeout``)."""
+        self.op_timeout = seconds
+        for conn in ([self._parent] if self._parent else []) + self._kids:
+            conn.set_timeout(seconds)
 
     # -- walkTable parity ----------------------------------------------------
     @staticmethod
@@ -237,10 +253,12 @@ class Tree:
             self._kid_server.close()
 
 
-def LocalhostTree(rank: int, num_nodes: int, port: int, base: int = 2) -> Tree:
+def LocalhostTree(rank: int, num_nodes: int, port: int, base: int = 2,
+                  **kwargs) -> Tree:
     """Single-host convenience (ref ``ipc.LocalhostTree(nodeIndex, numNodes)``,
-    examples/mnist.lua:16).  All ranks must pass the same ``port``."""
-    return Tree(rank, num_nodes, "127.0.0.1", port, base=base)
+    examples/mnist.lua:16).  All ranks must pass the same ``port``; extra
+    kwargs (``timeout``, ``op_timeout``) forward to :class:`Tree`."""
+    return Tree(rank, num_nodes, "127.0.0.1", port, base=base, **kwargs)
 
 
 def tree_map_spawn(fn: Callable, n: int, *args, timeout: float = 120.0

@@ -50,7 +50,7 @@ def _rmsnorm(params, x, eps=1e-6):
 def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
                    dtype=jnp.float32, compute_dtype=None,
-                   seq_impl: str = "ring") -> Model:
+                   seq_impl: str = "ring", remat: bool = False) -> Model:
     """Returns a :class:`Model` whose ``apply(params, state, tokens, ...)``
     maps int tokens [B, L_local] -> next-token logits [B, L_local, vocab].
 
@@ -59,6 +59,10 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     picks the sequence-parallel attention: ``"ring"`` (neighbor-hop K/V
     rotation, unbounded L) or ``"alltoall"`` (Ulysses head-scatter — needs
     heads divisible by the seq axis and the full score block in memory).
+    ``remat=True`` wraps each block in ``jax.checkpoint``: activations are
+    recomputed in the backward pass instead of saved — HBM drops from
+    O(depth * L * dim) to O(L * dim) at ~1/3 extra FLOPs, the standard
+    trade for long-context/deep configs.
     """
     if seq_impl not in ("ring", "alltoall"):
         raise ValueError(f"seq_impl must be 'ring' or 'alltoall', "
@@ -103,8 +107,7 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
         x = x + lax.dynamic_slice_in_dim(params["pos"], offset, L
                                          ).astype(cd)[None]
 
-        for i in range(depth):
-            blk = params[f"block{i}"]
+        def block(blk, x):
             h = _rmsnorm(blk["ln1"], x)
             if tp_axis is not None:   # enter column-parallel region ("f")
                 h = tp_enter(h, tp_axis)
@@ -128,7 +131,12 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
             h = h @ blk["w2"].astype(cd)
             if tp_axis is not None:   # hidden was sharded: reduce ("g")
                 h = tp_reduce(h, tp_axis)
-            x = x + h + blk["b2"].astype(cd)
+            return x + h + blk["b2"].astype(cd)
+
+        if remat:
+            block = jax.checkpoint(block)
+        for i in range(depth):
+            x = block(params[f"block{i}"], x)
 
         x = _rmsnorm(params["out_norm"], x)
         logits = x @ params["embed"].T.astype(cd)

@@ -82,3 +82,27 @@ def test_lm_gradients_flow():
     grads = jax.grad(lambda p: lm_loss(model, p, tokens))(params)
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint'ed blocks: identical logits and gradients, just a
+    different backward-pass memory/compute trade."""
+    import numpy as np
+    from jax import random
+    from distlearn_tpu.models.transformer import lm_loss, transformer_lm
+
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)),
+                       jnp.int32)
+    outs, grads = {}, {}
+    for remat in (False, True):
+        lm = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=16,
+                            remat=remat)
+        params, _ = lm.init(random.PRNGKey(0))
+        outs[remat] = np.asarray(lm.apply(params, {}, toks)[0])
+        grads[remat] = jax.grad(
+            lambda p: lm_loss(lm, p, toks))(params)
+    np.testing.assert_allclose(outs[False], outs[True], rtol=1e-6, atol=1e-7)
+    for a, b in zip(jax.tree_util.tree_leaves(grads[False]),
+                    jax.tree_util.tree_leaves(grads[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)

@@ -146,3 +146,31 @@ def test_barrier_and_ranks():
         return idx
 
     assert tree_map_spawn(node, n) == [0, 1, 2, 3]
+
+
+def test_op_timeout_detects_dead_rank():
+    """Failure detection: with op_timeout set, a collective waiting on a
+    dead/absent rank raises TimeoutError instead of hanging forever (the
+    reference wedges here — SURVEY.md §5)."""
+    import time
+    port = _port()
+
+    def node(rank):
+        t = LocalhostTree(rank, 2, port, base=2)
+        if rank == 1:
+            t.close()             # dies before participating
+            return None
+        t.set_op_timeout(0.5)
+        t0 = time.monotonic()
+        try:
+            t.all_reduce({"v": np.ones((4,), np.float32)})
+            return ("no-error", time.monotonic() - t0)
+        except (TimeoutError, ConnectionError) as e:
+            return (type(e).__name__, time.monotonic() - t0)
+        finally:
+            t.close()
+
+    results = tree_map_spawn(node, 2, timeout=30)
+    kind, dt = results[0]
+    assert kind in ("TimeoutError", "ConnectionError"), kind
+    assert dt < 10.0
