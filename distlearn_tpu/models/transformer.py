@@ -50,7 +50,9 @@ def _rmsnorm(params, x, eps=1e-6):
 def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
                    dtype=jnp.float32, compute_dtype=None,
-                   seq_impl: str = "ring", remat: bool = False) -> Model:
+                   seq_impl: str = "ring", remat: bool = False,
+                   moe_experts: int = 0, moe_every: int = 2,
+                   moe_capacity_factor: float = 1.25) -> Model:
     """Returns a :class:`Model` whose ``apply(params, state, tokens, ...)``
     maps int tokens [B, L_local] -> next-token logits [B, L_local, vocab].
 
@@ -63,11 +65,25 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     recomputed in the backward pass instead of saved — HBM drops from
     O(depth * L * dim) to O(L * dim) at ~1/3 extra FLOPs, the standard
     trade for long-context/deep configs.
+
+    ``moe_experts=E`` makes every ``moe_every``-th block's FFN a routed
+    top-1 mixture of ``E`` experts (parallel/ep.py).  Pass ``ep_axis`` to
+    ``apply`` to shard the experts one-per-device over that mesh axis
+    (requires ``E == axis size``; the data axis is the usual choice —
+    EP group == DP group); with ``ep_axis=None`` all experts run locally.
+    MoE blocks bypass tensor parallelism (their parallelism IS the expert
+    axis); the router stays replicated so routing is identical everywhere.
     """
     if seq_impl not in ("ring", "alltoall"):
         raise ValueError(f"seq_impl must be 'ring' or 'alltoall', "
                          f"got {seq_impl!r}")
+    if moe_experts < 0 or (moe_experts > 0 and moe_every < 1):
+        raise ValueError(f"moe_experts must be >= 0 and moe_every >= 1, "
+                         f"got {moe_experts}/{moe_every}")
     seq_attn = ring_attention if seq_impl == "ring" else alltoall_attention
+
+    def _is_moe(i: int) -> bool:
+        return moe_experts > 0 and (i % moe_every) == moe_every - 1
     head_dim = dim // heads
     hidden = dim * mlp_ratio
     cd = compute_dtype or dtype
@@ -81,23 +97,35 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
             "out_norm": _norm_init((dim,), dtype),
         }
         for i in range(depth):
-            params[f"block{i}"] = {
+            blk = {
                 "ln1": _norm_init((dim,), dtype),
                 "wq": random.normal(next(keys), (dim, heads, head_dim), dtype) * scale,
                 "wk": random.normal(next(keys), (dim, heads, head_dim), dtype) * scale,
                 "wv": random.normal(next(keys), (dim, heads, head_dim), dtype) * scale,
                 "wo": random.normal(next(keys), (heads, head_dim, dim), dtype) * scale,
                 "ln2": _norm_init((dim,), dtype),
-                "w1": random.normal(next(keys), (dim, hidden), dtype) * scale,
-                "b1": jnp.zeros((hidden,), dtype),
-                "w2": random.normal(next(keys), (hidden, dim), dtype)
-                      * (1.0 / math.sqrt(hidden)),
-                "b2": jnp.zeros((dim,), dtype),
             }
+            if _is_moe(i):
+                E = moe_experts
+                blk["router"] = random.normal(next(keys), (dim, E),
+                                              dtype) * scale
+                blk["we1"] = random.normal(next(keys), (E, dim, hidden),
+                                           dtype) * scale
+                blk["wb1"] = jnp.zeros((E, hidden), dtype)
+                blk["we2"] = random.normal(next(keys), (E, hidden, dim),
+                                           dtype) * (1.0 / math.sqrt(hidden))
+            else:
+                blk["w1"] = random.normal(next(keys), (dim, hidden),
+                                          dtype) * scale
+                blk["b1"] = jnp.zeros((hidden,), dtype)
+                blk["w2"] = random.normal(next(keys), (hidden, dim), dtype) \
+                    * (1.0 / math.sqrt(hidden))
+                blk["b2"] = jnp.zeros((dim,), dtype)
+            params[f"block{i}"] = blk
         return params, {}
 
     def apply(params, state, tokens, train=True, rng=None, axis_name=None,
-              bn_weight=None, seq_axis=None, tp_axis=None):
+              bn_weight=None, seq_axis=None, tp_axis=None, ep_axis=None):
         B, L = tokens.shape
         if seq_axis is not None:
             offset = lax.axis_index(seq_axis) * L
@@ -124,6 +152,27 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
             x = x + proj
 
             h = _rmsnorm(blk["ln2"], x)
+            if "router" in blk:       # routed MoE FFN (parallel/ep.py)
+                from distlearn_tpu.parallel.ep import moe_ffn, moe_ffn_local
+
+                Bq, Lq, Dq = h.shape
+                flat = h.reshape(Bq * Lq, Dq)
+
+                def expert(p, t):
+                    u = jax.nn.gelu(t @ p["we1"].astype(cd)
+                                    + p["wb1"].astype(cd))
+                    return u @ p["we2"].astype(cd)
+
+                eparams = {k: blk[k] for k in ("we1", "wb1", "we2")}
+                if ep_axis is None:
+                    y = moe_ffn_local(expert, eparams, blk["router"], flat,
+                                      moe_capacity_factor)
+                else:                 # one expert per device on ep_axis
+                    local = jax.tree_util.tree_map(
+                        lambda a: jnp.squeeze(a, 0), eparams)
+                    y = moe_ffn(expert, local, blk["router"], flat,
+                                moe_capacity_factor, axis_name=ep_axis)
+                return x + y.reshape(Bq, Lq, Dq).astype(x.dtype)
             if tp_axis is not None:
                 h = tp_enter(h, tp_axis)
             h = h @ blk["w1"].astype(cd) + blk["b1"].astype(cd)
@@ -146,15 +195,18 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                  input_shape=(max_len,), num_classes=vocab)
 
 
-def param_specs(params: PyTree, tp_axis: str | None) -> PyTree:
+def param_specs(params: PyTree, tp_axis: str | None,
+                ep_axis: str | None = None) -> PyTree:
     """PartitionSpecs for shard_map in_specs: TP shards heads / MLP hidden
-    over ``tp_axis``; everything else replicated."""
-    if tp_axis is None:
-        return jax.tree_util.tree_map(lambda _: P(), params)
-
+    over ``tp_axis``; EP shards the expert-stacked MoE leaves over
+    ``ep_axis`` (router replicated); everything else replicated."""
     def spec_for(path, leaf):
         names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
         leafname = names[-1]
+        if leafname in ("we1", "wb1", "we2"):
+            return P(ep_axis) if ep_axis else P()   # leading expert axis
+        if tp_axis is None:
+            return P()
         if leafname in ("wq", "wk", "wv"):
             return P(None, tp_axis)          # [E, H, D]: split heads
         if leafname == "wo":
@@ -171,7 +223,7 @@ def param_specs(params: PyTree, tp_axis: str | None) -> PyTree:
 
 
 def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
-            reduce: bool = True):
+            ep_axis=None, reduce: bool = True):
     """Next-token cross-entropy.  With a sequence axis, the final position's
     target lives on the next shard — the shift rides a ppermute so the loss
     is exact across shard boundaries.
@@ -183,7 +235,8 @@ def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
     gradients by the seq-axis size; differentiate the local share and psum
     the resulting partial gradients instead (distlearn_tpu.train.lm)."""
     logits, _ = model.apply(params, {}, tokens, train=True,
-                            seq_axis=seq_axis, tp_axis=tp_axis)
+                            seq_axis=seq_axis, tp_axis=tp_axis,
+                            ep_axis=ep_axis)
     if seq_axis is None:
         targets = tokens[:, 1:]
         lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))

@@ -57,6 +57,42 @@ def route_top1(router_logits: jax.Array, capacity: int
     return dispatch3, combine
 
 
+def _route_and_bucket(router_w: jax.Array, x: jax.Array,
+                      capacity_factor: float, E: int):
+    """Shared routing prologue: capacity, top-1 dispatch/combine masks, and
+    the per-expert token buckets.  ONE implementation so the local oracle
+    and the distributed path cannot silently diverge."""
+    N, _ = x.shape
+    capacity = max(1, int(-(-N * capacity_factor // E)))
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # [N, E]
+    dispatch, combine = route_top1(logits, capacity)
+    buckets = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    return combine, buckets, capacity
+
+
+def _combine(combine_w: jax.Array, expert_out: jax.Array) -> jax.Array:
+    return jnp.einsum("nec,ecd->nd", combine_w.astype(expert_out.dtype),
+                      expert_out)
+
+
+def moe_ffn_local(expert_fn: Callable, stacked_params: PyTree,
+                  router_w: jax.Array, x: jax.Array,
+                  capacity_factor: float = 1.25) -> jax.Array:
+    """Single-device mixture-of-experts (all experts resident): the same
+    routing/dispatch/combine math as :func:`moe_ffn` with the all-to-all
+    hops removed and the experts applied under ``vmap``.  This is both the
+    no-expert-axis fallback for MoE models and the reference oracle the
+    distributed path is tested against.
+
+    ``stacked_params``: pytree whose leaves carry a leading expert axis
+    ``[E, ...]``; ``expert_fn(params_e, tokens)`` applies ONE expert.
+    """
+    E = router_w.shape[1]
+    combine, buckets, _ = _route_and_bucket(router_w, x, capacity_factor, E)
+    out = jax.vmap(expert_fn)(stacked_params, buckets)      # [E, C, D]
+    return _combine(combine, out)
+
+
 def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
             x: jax.Array, capacity_factor: float = 1.25,
             axis_name: str = "expert") -> jax.Array:
@@ -81,12 +117,8 @@ def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
         raise ValueError(
             f"router_w must be [{D}, {E}] (token dim x expert-axis size, "
             f"one expert per device), got {router_w.shape}")
-    capacity = max(1, int(-(-N * capacity_factor // E)))
-    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # [N, E]
-    dispatch, combine = route_top1(logits, capacity)
-
-    # gather tokens into per-expert buckets: [E, C, D]
-    buckets = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    combine, buckets, capacity = _route_and_bucket(router_w, x,
+                                                   capacity_factor, E)
     # all-to-all: device e receives every peer's bucket for expert e,
     # stacked along a peer axis -> [E_peers, C, D] -> one batched FFN call
     recv = lax.all_to_all(buckets, axis_name, split_axis=0, concat_axis=0,
@@ -96,4 +128,4 @@ def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
     # reverse hop: peers get their tokens back at the same coordinates
     home = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)                       # [E, C, D]
-    return jnp.einsum("nec,ecd->nd", combine.astype(home.dtype), home)
+    return _combine(combine, home)

@@ -19,7 +19,8 @@ from distlearn_tpu.models.transformer import lm_loss, param_specs
 
 def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                   data_axis: str = "data", seq_axis: str | None = "seq",
-                  tp_axis: str | None = "model", donate: bool = True
+                  tp_axis: str | None = "model",
+                  ep_axis: str | None = None, donate: bool = True
                   ) -> Callable:
     """``step(params, tokens) -> (params, loss)``.
 
@@ -28,9 +29,24 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
     across data/seq).  Gradients are psum'd over data+seq axes (params are
     replicated there); TP-sharded leaves need no gradient collective — each
     device owns its slice.
+
+    ``ep_axis`` (MoE models): the mesh axis the expert-stacked leaves are
+    sharded over — normally ``data_axis`` itself (EP group == DP group,
+    one expert per data-parallel device).  Expert leaves are EXCLUDED from
+    the data-axis gradient psum: each device owns a distinct expert slice,
+    and the transposed all-to-all already accumulated every replica's
+    contribution to it; summing across the axis would mix different
+    experts' gradients.  They still reduce over ``seq_axis`` (each
+    sequence shard routes its own tokens) and share the 1/dp objective
+    scaling.
     """
     axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
-    pspecs = param_specs(params_template, tp_axis)
+    # expert leaves reduce over every replicated axis EXCEPT the one that
+    # shards them — summing across ep_axis would mix different experts
+    ep_grad_axes = tuple(a for a in axes if a != ep_axis)
+    pspecs = param_specs(params_template, tp_axis, ep_axis)
+    is_ep_leaf = jax.tree_util.tree_map(
+        lambda s: ep_axis is not None and ep_axis in s, pspecs)
 
     def step(params, tokens):
         # differentiate the LOCAL loss share (reduce=False): see lm_loss —
@@ -38,7 +54,8 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
         # must not sit inside the differentiated function
         local_loss, grads = jax.value_and_grad(
             lambda p: lm_loss(model, p, tokens, seq_axis=seq_axis,
-                              tp_axis=tp_axis, reduce=False))(params)
+                              tp_axis=tp_axis, ep_axis=ep_axis,
+                              reduce=False))(params)
         loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
         # Sum partial grads over seq (params replicated there, each shard
         # holds part of the chain) and AVERAGE over data (the global
@@ -46,8 +63,14 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
         # allreduce_sgd's 1/n convention).  TP leaves need no collective:
         # the f/g pattern leaves each slice's gradient exact.
         dp = lax.psum(1, data_axis)
-        grads = jax.tree_util.tree_map(
-            lambda g: lax.psum(g, axes) / jnp.asarray(dp, g.dtype), grads)
+
+        def reduce_grad(g, is_ep):
+            gaxes = ep_grad_axes if is_ep else axes
+            if gaxes:
+                g = lax.psum(g, gaxes)
+            return g / jnp.asarray(dp, g.dtype)
+
+        grads = jax.tree_util.tree_map(reduce_grad, grads, is_ep_leaf)
         new_params = jax.tree_util.tree_map(
             lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
             params, grads)
