@@ -80,6 +80,11 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     if moe_experts < 0 or (moe_experts > 0 and moe_every < 1):
         raise ValueError(f"moe_experts must be >= 0 and moe_every >= 1, "
                          f"got {moe_experts}/{moe_every}")
+    if moe_experts > 0 and moe_every > depth:
+        raise ValueError(
+            f"moe_every={moe_every} > depth={depth}: no block would be MoE "
+            f"— the requested {moe_experts}-expert model would silently "
+            "train dense")
     seq_attn = ring_attention if seq_impl == "ring" else alltoall_attention
 
     def _is_moe(i: int) -> bool:
@@ -168,6 +173,13 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                     y = moe_ffn_local(expert, eparams, blk["router"], flat,
                                       moe_capacity_factor)
                 else:                 # one expert per device on ep_axis
+                    n_local = blk["we1"].shape[0]
+                    if n_local != 1:
+                        raise ValueError(
+                            f"moe_experts ({moe_experts}) must equal the "
+                            f"ep_axis size (this device holds {n_local} "
+                            "expert shards; expected exactly one per "
+                            "device)")
                     local = jax.tree_util.tree_map(
                         lambda a: jnp.squeeze(a, 0), eparams)
                     y = moe_ffn(expert, local, blk["router"], flat,

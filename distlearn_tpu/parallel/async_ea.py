@@ -194,14 +194,30 @@ class AsyncEAServer:
             print_server(f"received delta from client #{self.current_client}")
             return _rebuild(params, [t.copy() for t in self.center])
 
-    def test_net(self):
-        """Push the center to the tester (ref ``testNet``, lua :239-258)."""
+    def test_net(self) -> bool:
+        """Push the center to the tester (ref ``testNet``, lua :239-258).
+
+        A dead/hung tester must not stall training: the handshake runs
+        under ``handshake_timeout`` and a failed tester is dropped (later
+        calls no-op, returning False)."""
         conn = self.test_conn
-        conn.send_msg(TEST_Q)
-        _expect(conn, CENTER_Q)
-        for t in self.center:
-            conn.send_tensor(t)
-        _expect(conn, ACK)
+        if conn is None:
+            return False
+        try:
+            conn.set_timeout(self.handshake_timeout)
+            conn.send_msg(TEST_Q)
+            _expect(conn, CENTER_Q)
+            for t in self.center:
+                conn.send_tensor(t)
+            _expect(conn, ACK)
+            conn.set_timeout(None)
+            return True
+        except (TimeoutError, ConnectionError, ProtocolError, OSError,
+                ValueError) as e:
+            print_server(f"dropping tester: {e!r}")
+            conn.close()
+            self.test_conn = None
+            return False
 
     def close(self):
         self.broadcast.close()

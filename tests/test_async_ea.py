@@ -248,3 +248,32 @@ def test_hung_client_evicted_by_timeout():
     assert 2 in srv.evicted
     assert out["synced"]
     np.testing.assert_allclose(new_params["w"], 0.5)
+
+
+def test_dead_tester_dropped_server_continues():
+    """A tester that dies mid-push must be dropped (test_net returns False)
+    without stalling the serve loop."""
+    from distlearn_tpu.comm.transport import connect
+
+    port = _ports()
+    out = {}
+
+    def tester_fn():
+        t = connect("127.0.0.1", port + 2)   # test channel: port+numNodes+1
+        t.close()                            # dies immediately
+
+    tt = threading.Thread(target=tester_fn)
+    tl = threading.Thread(target=_live_client_fn, args=(port, out, 0.2))
+    tt.start()
+    tl.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1, with_tester=True,
+                        handshake_timeout=0.5)
+    srv.init_server(_params())
+    srv.sync_server(_params())
+    assert srv.test_net() is False           # dropped, not wedged
+    assert srv.test_conn is None
+    assert srv.test_net() is False           # later calls no-op
+    tt.join(timeout=10)
+    tl.join(timeout=30)
+    srv.close()
+    assert out["synced"]

@@ -87,3 +87,13 @@ def test_moe_lm_ep_step_matches_single_device():
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(ref_leaf), rtol=2e-4, atol=2e-5,
             err_msg=jax.tree_util.keystr(path))
+
+
+def test_moe_config_validation():
+    import pytest
+    with pytest.raises(ValueError, match="silently train dense"):
+        transformer_lm(vocab=V, dim=DIM, depth=2, heads=HEADS, max_len=L,
+                       moe_experts=4, moe_every=4)
+    with pytest.raises(ValueError, match="moe_every >= 1"):
+        transformer_lm(vocab=V, dim=DIM, depth=2, heads=HEADS, max_len=L,
+                       moe_experts=4, moe_every=0)
