@@ -130,11 +130,50 @@ def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           tiled=True)
 
 
+def _flash_enabled(override: bool | None) -> bool:
+    """Opt-in Pallas flash-attention (TPU only).  Priority: explicit arg >
+    ``DISTLEARN_TPU_FLASH`` env > off.  Off by default because at moderate
+    lengths XLA's own fused attention is on par (measured on v5e: flash
+    wins ~10-12% at L >= 4096 and removes the O(L^2) score buffer — turn
+    it on for long-context configs)."""
+    if override is not None:
+        return bool(override)
+    import os
+    env = os.environ.get("DISTLEARN_TPU_FLASH")
+    return env is not None and env.lower() not in ("0", "false", "off", "")
+
+
 def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    causal: bool = False) -> jax.Array:
-    """Single-device reference attention (same layout), for tests and
-    non-sharded runs.  q/k/v: [B, L, H, D]."""
+                    causal: bool = False,
+                    flash: bool | None = None) -> jax.Array:
+    """Single-device attention (same layout as the sharded variants), for
+    non-sharded runs and as the per-shard kernel of
+    :func:`alltoall_attention`.  q/k/v: [B, L, H, D].
+
+    With ``flash`` (or ``DISTLEARN_TPU_FLASH=1``) the inner kernel is the
+    Pallas TPU flash attention — blockwise online softmax in VMEM, no
+    ``[B, H, L, L]`` score materialization."""
     B, L, H, D = q.shape
+    if _flash_enabled(flash):
+        # the Pallas kernel's default blocking needs L to be a multiple of
+        # its 128-wide blocks
+        supported = jax.default_backend() == "tpu" and L >= 128 and L % 128 == 0
+        if supported:
+            from jax.experimental.pallas.ops.tpu.flash_attention import \
+                flash_attention
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), causal=causal,
+                sm_scale=1.0 / (D ** 0.5))
+            return out.transpose(0, 2, 1, 3).astype(q.dtype)
+        if flash:
+            # explicitly requested — refusing loudly beats silently
+            # materializing the O(L^2) buffer the caller asked to avoid
+            raise ValueError(
+                "flash attention needs the TPU backend and seq len a "
+                f"multiple of 128; got backend={jax.default_backend()}, "
+                f"L={L}. Drop flash=True to use the portable path.")
+        # env-enabled but unsupported here: portable fallback
     scale = 1.0 / (D ** 0.5)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32),

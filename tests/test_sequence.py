@@ -144,3 +144,39 @@ def test_alltoall_rejects_indivisible_heads():
             mesh=mesh, in_specs=(P(None, "seq"),) * 3,
             out_specs=P(None, "seq"), check_vma=False)(
             q[:, :30], k[:, :30], v[:, :30])
+
+
+def _qkv_long(seed, L=256):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, L, H, D).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="Pallas flash attention is a TPU kernel")
+def test_flash_local_attention_matches_reference():
+    q, k, v = _qkv_long(6)                 # L=256: kernel-block compatible
+    out_f = local_attention(q, k, v, causal=True, flash=True)
+    out_r = local_attention(q, k, v, causal=True, flash=False)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_flash_explicit_request_rejected_when_unsupported(monkeypatch):
+    """flash=True must not be silently ignored: on a non-TPU backend (or
+    incompatible L) it raises instead of materializing the O(L^2) buffer
+    the caller asked to avoid."""
+    monkeypatch.delenv("DISTLEARN_TPU_FLASH", raising=False)
+    q, k, v = _qkv(7)                      # L=32 also violates blocking
+    with pytest.raises(ValueError, match="flash attention needs"):
+        local_attention(q, k, v, causal=True, flash=True)
+
+
+def test_flash_env_fallback_on_unsupported(monkeypatch):
+    """Env-enabled flash falls back to the portable path where the kernel
+    can't run (CPU mesh / L % 128 != 0) — same numbers as flash off."""
+    monkeypatch.setenv("DISTLEARN_TPU_FLASH", "1")
+    q, k, v = _qkv(8)
+    out = local_attention(q, k, v, causal=True)        # flash=None -> env
+    ref = local_attention(q, k, v, causal=True, flash=False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
