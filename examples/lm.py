@@ -39,6 +39,8 @@ def main():
         "moeExperts": (0, "experts per MoE block (0 = dense; must equal "
                           "--dp, experts shard over the data axis)"),
         "remat": (False, "jax.checkpoint each block (long-context memory)"),
+        "profile": ("", "capture a jax.profiler trace of steps 6..10 into "
+                        "this directory (view in TensorBoard/Perfetto)"),
         "bf16": (False, "bfloat16 compute"),
         "tpu": (False, "run on the TPU backend"),
         "seed": (0, "init seed"),
@@ -100,13 +102,29 @@ def main():
     tokens = jax.device_put(jnp.asarray(toks),
                             NamedSharding(mesh, P("data", "seq")))
 
+    from contextlib import ExitStack
+
+    from distlearn_tpu.utils.profiling import trace
+
     timer = StepTimer()
-    for i in range(1, opt.steps + 1):
-        timer.tick()
-        params, loss = step(params, tokens)
-        if i % 10 == 0 or i == opt.steps:
-            log(f"step {i}: loss {float(loss):.4f} "
-                f"({timer.steps_per_sec():.2f} steps/s)")
+    do_profile = bool(opt.profile) and opt.steps >= 6
+    if opt.profile and not do_profile:
+        log(f"--profile ignored: needs --steps >= 6 (warmup is steps 1-5), "
+            f"got {opt.steps}")
+    prof_stop = min(10, opt.steps)
+    with ExitStack() as stack:            # guarantees stop_trace on error
+        for i in range(1, opt.steps + 1):
+            if do_profile and i == 6:     # skip compile + warmup steps
+                stack.enter_context(trace(opt.profile))
+            timer.tick()
+            params, loss = step(params, tokens)
+            if do_profile and i == prof_stop:
+                jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+                stack.close()
+                log(f"profiler trace written to {opt.profile}")
+            if i % 10 == 0 or i == opt.steps:
+                log(f"step {i}: loss {float(loss):.4f} "
+                    f"({timer.steps_per_sec():.2f} steps/s)")
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
     log("done")
 
