@@ -42,6 +42,12 @@ class StepTimer:
             self._times.append(now - self._last)
         self._last = now
 
+    def reset_window(self):
+        """Drop the in-progress interval — call after an out-of-band
+        ``block_until_ready`` (checkpoint, profiler boundary) so the queue
+        drain isn't recorded as one giant step."""
+        self._last = None
+
     @property
     def steps(self) -> int:
         return max(0, len(self._times) - self.warmup)

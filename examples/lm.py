@@ -54,11 +54,13 @@ def main():
     from jax import random
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from contextlib import ExitStack
+
     from distlearn_tpu.models.transformer import (lm_loss, param_specs,
                                                   transformer_lm)
     from distlearn_tpu.train.lm import build_lm_step
     from distlearn_tpu.utils.logging import root_print
-    from distlearn_tpu.utils.profiling import StepTimer
+    from distlearn_tpu.utils.profiling import StepTimer, trace
 
     log = root_print(0)
     if opt.moeExperts and opt.moeExperts != opt.dp:
@@ -102,10 +104,6 @@ def main():
     tokens = jax.device_put(jnp.asarray(toks),
                             NamedSharding(mesh, P("data", "seq")))
 
-    from contextlib import ExitStack
-
-    from distlearn_tpu.utils.profiling import trace
-
     timer = StepTimer()
     do_profile = bool(opt.profile) and opt.steps >= 6
     if opt.profile and not do_profile:
@@ -115,11 +113,15 @@ def main():
     with ExitStack() as stack:            # guarantees stop_trace on error
         for i in range(1, opt.steps + 1):
             if do_profile and i == 6:     # skip compile + warmup steps
+                # drain the async queue so warmup work isn't in the trace
+                jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+                timer.reset_window()      # drain time is not a step
                 stack.enter_context(trace(opt.profile))
             timer.tick()
             params, loss = step(params, tokens)
             if do_profile and i == prof_stop:
                 jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+                timer.reset_window()
                 stack.close()
                 log(f"profiler trace written to {opt.profile}")
             if i % 10 == 0 or i == opt.steps:
