@@ -54,6 +54,12 @@ TRAIN_FLAGS = {
     "seed": (0, "init seed (reference: torch.manualSeed(0))"),
 }
 
+CKPT_FLAGS = {
+    "save": ("", "checkpoint dir (empty = off; SURVEY.md §5 first-class "
+                 "checkpoint/resume)"),
+    "resume": (False, "resume from newest checkpoint in --save"),
+}
+
 EA_FLAGS = {
     "communicationTime": (10, "tau — steps between elastic rounds"),
     "alpha": (0.2, "elastic moving rate"),

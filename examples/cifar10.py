@@ -15,7 +15,8 @@ Run:  python examples/cifar10.py --numNodes 4 --batchSize 128 [--tpu]
 from __future__ import annotations
 
 from common import setup_platform, resolve_num_nodes, device_stream
-from distlearn_tpu.utils.flags import parse_flags, NODE_FLAGS, TRAIN_FLAGS
+from distlearn_tpu.utils.flags import (parse_flags, CKPT_FLAGS,
+                                       NODE_FLAGS, TRAIN_FLAGS)
 
 
 def main():
@@ -26,8 +27,7 @@ def main():
         "data": ("", "path to .npz with x [N,32,32,3]/y (default: synthetic)"),
         "numExamples": (8192, "synthetic dataset size"),
         "testExamples": (1024, "synthetic test-set size"),
-        "save": ("", "checkpoint dir (empty = off)"),
-        "resume": (False, "resume from newest checkpoint in --save"),
+        **CKPT_FLAGS,
         "bf16": (False, "bfloat16 compute (MXU path)"),
         "testData": ("", "path to a test-split .npz (tools/make_npz.py "
                          "emits one; default: last 10% of --data)"),

@@ -14,8 +14,8 @@ Run:  python examples/mnist_ea.py --numNodes 4 [--tpu]
 from __future__ import annotations
 
 from common import setup_platform, resolve_num_nodes, device_stream
-from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
-                                       EA_FLAGS)
+from distlearn_tpu.utils.flags import (parse_flags, CKPT_FLAGS, NODE_FLAGS,
+                                       TRAIN_FLAGS, EA_FLAGS)
 
 
 def main():
@@ -27,6 +27,7 @@ def main():
         "data": ("", "path to .npz (default: synthetic)"),
         "numExamples": (4096, "synthetic dataset size"),
         "reportEvery": (100, "steps between reports"),
+        **CKPT_FLAGS,
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -41,6 +42,7 @@ def main():
     from distlearn_tpu.parallel.mesh import MeshTree
     from distlearn_tpu.train import (build_ea_steps, init_ea_state,
                                      reduce_confusion)
+    from distlearn_tpu.utils import checkpoint as ckpt
     from distlearn_tpu.utils import metrics as M
     from distlearn_tpu.utils.logging import root_print
     from distlearn_tpu.utils.profiling import StepTimer
@@ -61,9 +63,25 @@ def main():
                                           alpha=opt.alpha)
     tau = opt.communicationTime
 
-    timer = StepTimer()
+    start_epoch = 1
     global_step = 0
-    for epoch in range(1, opt.numEpochs + 1):
+    if opt.resume and opt.save and ckpt.latest_step(opt.save) is not None:
+        restorable = {"params": ets.params, "model_state": ets.model_state,
+                      "center": ets.center}
+        restored, meta = ckpt.restore_checkpoint(opt.save, restorable)
+        # re-place host arrays onto the mesh (stacked per-node sharding)
+        ets = ets._replace(params=tree.put_per_node(restored["params"]),
+                           model_state=tree.put_per_node(
+                               restored["model_state"]),
+                           center=tree.put_per_node(restored["center"]))
+        start_epoch = meta["step"] + 1
+        # resume the step counter too: the tau-spaced elastic-round cadence
+        # must continue in phase with the uninterrupted run
+        global_step = int(meta.get("global_step", 0))
+        log(f"resumed from epoch {meta['step']} (step {global_step})")
+
+    timer = StepTimer()
+    for epoch in range(start_epoch, opt.numEpochs + 1):
         sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
         for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
             timer.tick()
@@ -83,6 +101,13 @@ def main():
             center=tree.scatter(ets.center, src=0),
             cm=jax.tree_util.tree_map(lambda c: c * 0, ets.cm))
         log(f"epoch {epoch}: ({timer.steps_per_sec():.1f} steps/s)")
+        if opt.save:
+            ckpt.save_checkpoint(
+                opt.save, epoch,
+                {"params": ets.params, "model_state": ets.model_state,
+                 "center": ets.center},
+                metadata={"epoch": epoch, "global_step": global_step,
+                          "tau": tau, "alpha": opt.alpha})
     jax.block_until_ready(ets.params)
     log("done")
 

@@ -15,7 +15,8 @@ Run:  python examples/resnet50.py --numNodes 8 --batchSize 256
 from __future__ import annotations
 
 from common import setup_platform, resolve_num_nodes, device_stream
-from distlearn_tpu.utils.flags import parse_flags, NODE_FLAGS, TRAIN_FLAGS
+from distlearn_tpu.utils.flags import (parse_flags, CKPT_FLAGS,
+                                       NODE_FLAGS, TRAIN_FLAGS)
 
 
 def main():
@@ -27,8 +28,7 @@ def main():
         "numClasses": (1000, "label count"),
         "numExamples": (2048, "synthetic dataset size"),
         "data": ("", "path to .npz with x [N,S,S,3]/y (default: synthetic)"),
-        "save": ("", "checkpoint dir (empty = off)"),
-        "resume": (False, "resume from newest checkpoint in --save"),
+        **CKPT_FLAGS,
         "bf16": (False, "bfloat16 compute (MXU path)"),
         "bucketMB": (16, "gradient bucket size in MiB (0 = one bucket)"),
         "stepsPerEpoch": (0, "cap steps per epoch (0 = full epoch)"),

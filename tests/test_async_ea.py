@@ -277,3 +277,50 @@ def test_dead_tester_dropped_server_continues():
     tl.join(timeout=30)
     srv.close()
     assert out["synced"]
+
+
+def test_many_clients_with_abrupt_disconnects():
+    """4 clients sync concurrently with uneven round counts; two disconnect
+    ABRUPTLY (raw socket close, no protocol goodbye) after their rounds.
+    The server must keep serving through the dirty EOFs (recv_any drops
+    them) and the center must equal the sum of every delivered delta —
+    mid-HANDSHAKE deaths are covered by test_dead_client_evicted_* above."""
+    port = _ports(12)
+    alpha, tau = 0.5, 1
+    rounds = {1: 6, 2: 2, 3: 6, 4: 3}     # clients 2 and 4 stop early
+    dies = {2, 4}
+    sent = []
+    lock = threading.Lock()
+
+    def client_fn(node):
+        c = AsyncEAClient("127.0.0.1", port, node=node, tau=tau, alpha=alpha)
+        p = c.init_client({"w": np.zeros((2, 2), np.float32)})
+        for r in range(rounds[node]):
+            p = {"w": p["w"] + node * 0.1}
+            before = p["w"].copy()
+            p, synced = c.sync_client(p)
+            assert synced
+            with lock:
+                sent.append(before - p["w"])
+        if node in dies:
+            c.conn.sock.close()           # dies abruptly (no clean close)
+            c.broadcast.sock.close()
+        else:
+            c.close()
+
+    threads = [threading.Thread(target=client_fn, args=(i,))
+               for i in rounds]
+    for t in threads:
+        t.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=4,
+                        handshake_timeout=2.0)
+    srv.init_server({"w": np.zeros((2, 2), np.float32)})
+    total_syncs = sum(rounds.values())
+    for _ in range(total_syncs):
+        srv.sync_server({"w": np.zeros((2, 2), np.float32)})
+    for t in threads:
+        t.join(timeout=60)
+    # every delta that a client saw complete must be on the center exactly once
+    np.testing.assert_allclose(srv.center[0], np.sum(sent, axis=0),
+                               rtol=1e-5, atol=1e-5)
+    srv.close()
