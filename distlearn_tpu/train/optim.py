@@ -1,0 +1,99 @@
+"""Optax-backed fused train step — the reference's ``optim`` library slot.
+
+The reference's examples hand-roll SGD (examples/mnist.lua:112-116) but its
+ecosystem slot for optimizers is the external ``optim`` package (sgd with
+momentum, adagrad, ... — SURVEY.md §2b "optim/xlua/lapp" row).  The
+TPU-native equivalent is optax: any ``GradientTransformation`` drops into
+the same fused AllReduceSGD step — forward, backward, gradient psum with
+contributor normalization, optimizer update, metrics — still ONE XLA
+program per step.  :func:`build_sgd_step` stays the bare-SGD hot path
+(reference parity + the Pallas fused-update route); this builder is the
+general-optimizer variant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, random
+from jax.sharding import PartitionSpec as P
+
+from distlearn_tpu.models.core import Model, loss_fn
+from distlearn_tpu.parallel import allreduce_sgd
+from distlearn_tpu.parallel import mesh as mesh_lib
+from distlearn_tpu.parallel.mesh import MeshTree
+from distlearn_tpu.utils import metrics as metrics_lib
+
+PyTree = Any
+
+
+class OptaxTrainState(NamedTuple):
+    """Like trainer.TrainState plus the optimizer state (replicated — it is
+    a deterministic function of the replicated params/grads)."""
+    params: PyTree
+    model_state: PyTree
+    opt_state: PyTree
+    sync: Any
+    cm: jax.Array
+    rng: jax.Array
+
+
+def init_optax_state(model: Model, tree: MeshTree, tx, key: jax.Array,
+                     num_classes: int) -> OptaxTrainState:
+    init_key, train_key = random.split(key)
+    params, mstate = model.init(init_key)
+    n = tree.num_nodes
+    return OptaxTrainState(
+        params=params, model_state=mstate, opt_state=tx.init(params),
+        sync=allreduce_sgd.SGDSyncState(
+            my_steps=tree.put_per_node(jnp.zeros((n,), jnp.int32))),
+        cm=tree.put_per_node(jnp.zeros((n, num_classes, num_classes),
+                                       jnp.int32)),
+        rng=train_key)
+
+
+def build_optax_step(model: Model, tree: MeshTree, tx,
+                     donate: bool = True) -> Callable:
+    """One fused data-parallel step with an optax optimizer:
+    ``step(ts, x, y) -> (ts, loss)``.
+
+    Same collective structure as :func:`~distlearn_tpu.train.build_sgd_step`
+    (params replicated, batch sharded, grads psum'd + contributor-
+    normalized before the update), with ``tx.update`` in place of the bare
+    SGD rule — e.g. ``optax.sgd(lr, momentum=0.9)``, ``optax.adamw(lr)``.
+    The optimizer state stays bitwise-replicated because every replica
+    applies the identical psum'd gradient.
+    """
+    axis = tree.axis_name
+
+    def step(ts: OptaxTrainState, x, y):
+        rng, dropout_rng = random.split(ts.rng)
+        dropout_rng = random.fold_in(dropout_rng, lax.axis_index(axis))
+
+        def _loss(p):
+            return loss_fn(model, p, ts.model_state, x, y, train=True,
+                           rng=dropout_rng, axis_name=axis)
+
+        (loss, (log_probs, mstate)), grads = \
+            jax.value_and_grad(_loss, has_aux=True)(ts.params)
+        sync_local = mesh_lib.squeeze_node(ts.sync)
+        grads, sync_local, _ = allreduce_sgd.sum_and_normalize_gradients(
+            grads, sync_local, axis_name=axis)
+        updates, opt_state = tx.update(grads, ts.opt_state, ts.params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), ts.params, updates)
+        cm_new = metrics_lib.update_confusion(jnp.squeeze(ts.cm, 0),
+                                              log_probs, y)
+        new_ts = OptaxTrainState(params, mstate, opt_state,
+                                 mesh_lib.expand_node(sync_local),
+                                 cm_new[None], rng)
+        return new_ts, lax.pmean(loss, axis)
+
+    specs = OptaxTrainState(params=P(), model_state=P(), opt_state=P(),
+                            sync=P(axis), cm=P(axis), rng=P())
+    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
+                                                           P(axis)),
+                           out_specs=(specs, P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
