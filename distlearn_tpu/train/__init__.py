@@ -7,12 +7,15 @@ from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          build_eval_step, build_ea_steps,
                                          reduce_confusion)
 from distlearn_tpu.train.lm import build_lm_step
-from distlearn_tpu.train.optim import (OptaxTrainState, build_optax_step,
-                                       init_optax_state)
+from distlearn_tpu.train.optim import (OptaxTrainState, ZeroTrainState,
+                                       build_optax_step,
+                                       build_zero_optax_step,
+                                       init_optax_state, init_zero_state)
 
 __all__ = [
     "TrainState", "EATrainState", "init_train_state", "init_ea_state",
     "build_sgd_step", "build_sync_step", "build_eval_step", "build_ea_steps",
     "reduce_confusion", "build_lm_step",
     "OptaxTrainState", "build_optax_step", "init_optax_state",
+    "ZeroTrainState", "build_zero_optax_step", "init_zero_state",
 ]

@@ -21,6 +21,7 @@ from jax import lax, random
 from jax.sharding import PartitionSpec as P
 
 from distlearn_tpu.models.core import Model, loss_fn
+from distlearn_tpu.ops import flatten as flatten_lib
 from distlearn_tpu.parallel import allreduce_sgd
 from distlearn_tpu.parallel import mesh as mesh_lib
 from distlearn_tpu.parallel.mesh import MeshTree
@@ -42,16 +43,12 @@ class OptaxTrainState(NamedTuple):
 
 def init_optax_state(model: Model, tree: MeshTree, tx, key: jax.Array,
                      num_classes: int) -> OptaxTrainState:
-    init_key, train_key = random.split(key)
-    params, mstate = model.init(init_key)
-    n = tree.num_nodes
-    return OptaxTrainState(
-        params=params, model_state=mstate, opt_state=tx.init(params),
-        sync=allreduce_sgd.SGDSyncState(
-            my_steps=tree.put_per_node(jnp.zeros((n,), jnp.int32))),
-        cm=tree.put_per_node(jnp.zeros((n, num_classes, num_classes),
-                                       jnp.int32)),
-        rng=train_key)
+    from distlearn_tpu.train.trainer import init_common
+    params, mstate, sync, cm, rng = init_common(model, tree, key,
+                                                num_classes)
+    return OptaxTrainState(params=params, model_state=mstate,
+                           opt_state=tx.init(params), sync=sync, cm=cm,
+                           rng=rng)
 
 
 def build_optax_step(model: Model, tree: MeshTree, tx,
@@ -93,6 +90,125 @@ def build_optax_step(model: Model, tree: MeshTree, tx,
 
     specs = OptaxTrainState(params=P(), model_state=P(), opt_state=P(),
                             sync=P(axis), cm=P(axis), rng=P())
+    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
+                                                           P(axis)),
+                           out_specs=(specs, P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1: optimizer state sharded over the data axis
+# ---------------------------------------------------------------------------
+
+class ZeroTrainState(NamedTuple):
+    """Params replicated; OPTIMIZER STATE SHARDED — each device holds the
+    state for only its 1/N slice of the flattened parameters (ZeRO stage 1:
+    with Adam that cuts the 2x-params state memory by the data-axis size).
+    ``opt_state`` leaves are stacked node arrays ``[N, ...]`` over the
+    axis, like the EA per-node state."""
+    params: PyTree
+    model_state: PyTree
+    opt_state: PyTree
+    sync: Any
+    cm: jax.Array
+    rng: jax.Array
+
+
+def _zero_layout(params: PyTree, n: int):
+    """(FlatSpec, shard-divisible flat length, per-device chunk)."""
+    for leaf in jax.tree_util.tree_leaves(params):
+        if jnp.asarray(leaf).dtype != jnp.float32:
+            raise ValueError(
+                "ZeRO sharding packs params into one f32 buffer; got a "
+                f"{jnp.asarray(leaf).dtype} leaf (use build_optax_step for "
+                "mixed-dtype trees)")
+    spec = flatten_lib.make_spec(params)
+    total = ((spec.padded + n - 1) // n) * n
+    return spec, total, total // n
+
+
+def _pack_padded(spec, tree, total: int) -> jax.Array:
+    flat = flatten_lib.pack(spec, tree)
+    if total > spec.padded:
+        flat = jnp.concatenate([flat, jnp.zeros(total - spec.padded,
+                                                flat.dtype)])
+    return flat
+
+
+def init_zero_state(model: Model, tree: MeshTree, tx, key: jax.Array,
+                    num_classes: int) -> ZeroTrainState:
+    from distlearn_tpu.train.trainer import init_common
+    params, mstate, sync, cm, rng = init_common(model, tree, key,
+                                                num_classes)
+    n = tree.num_nodes
+    spec, total, chunk = _zero_layout(params, n)
+    slices = _pack_padded(spec, params, total).reshape(n, chunk)
+    per_dev = [tx.init(slices[i]) for i in range(n)]
+    opt = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_dev)
+    return ZeroTrainState(params=params, model_state=mstate,
+                          opt_state=tree.put_per_node(opt), sync=sync,
+                          cm=cm, rng=rng)
+
+
+def build_zero_optax_step(model: Model, tree: MeshTree, tx,
+                          donate: bool = True) -> Callable:
+    """ZeRO-1 fused step: ``step(ts, x, y) -> (ts, loss)``.
+
+    Comm structure (the ZeRO-1 recipe): local gradients are packed flat
+    and **reduce-scattered** — each device receives only the summed 1/N
+    chunk its optimizer state covers (~P bytes over the ring vs ~2P for
+    the non-sharded path's full allreduce) — the sliced elementwise
+    ``tx.update`` runs against the sharded state, and ONE tiled
+    ``all_gather`` reassembles the updated parameters (replicated again
+    for the next step).  Net: allreduce-equivalent bandwidth
+    (reduce-scatter + all-gather) with the optimizer-state memory cut by
+    N.  Restricted to ELEMENTWISE optimizers (adam, momentum, rmsprop...):
+    a transform that couples slices, e.g. ``clip_by_global_norm``, would
+    see only its shard's norm.  Full participation each step (uneven-step
+    accounting keeps the reference cadence via the sync counter).
+    """
+    axis = tree.axis_name
+    n = tree.num_nodes
+
+    def step(ts: ZeroTrainState, x, y):
+        spec, total, chunk = _zero_layout(ts.params, n)
+        rng, dropout_rng = random.split(ts.rng)
+        dropout_rng = random.fold_in(dropout_rng, lax.axis_index(axis))
+
+        def _loss(p):
+            return loss_fn(model, p, ts.model_state, x, y, train=True,
+                           rng=dropout_rng, axis_name=axis)
+
+        (loss, (log_probs, mstate)), grads = \
+            jax.value_and_grad(_loss, has_aux=True)(ts.params)
+        sync_local = mesh_lib.squeeze_node(ts.sync)
+        sync_local = allreduce_sgd.SGDSyncState(
+            my_steps=sync_local.my_steps + 1)
+
+        # reduce-scatter the packed LOCAL grads: arrives pre-sliced +
+        # summed; normalize by the (full-participation) node count
+        my = lax.axis_index(axis)
+        gslice = lax.psum_scatter(
+            _pack_padded(spec, grads, total), axis,
+            scatter_dimension=0, tiled=True) / jnp.float32(n)
+        pslice = lax.dynamic_slice_in_dim(
+            _pack_padded(spec, ts.params, total), my * chunk, chunk)
+        opt_local = mesh_lib.squeeze_node(ts.opt_state)
+        updates, opt_local = tx.update(gslice, opt_local, pslice)
+        new_slice = pslice + updates
+        flat_new = lax.all_gather(new_slice, axis, tiled=True)   # [total]
+        params = flatten_lib.unpack(spec, flat_new)
+
+        cm_new = metrics_lib.update_confusion(jnp.squeeze(ts.cm, 0),
+                                              log_probs, y)
+        new_ts = ZeroTrainState(params, mstate,
+                                mesh_lib.expand_node(opt_local),
+                                mesh_lib.expand_node(sync_local),
+                                cm_new[None], rng)
+        return new_ts, lax.pmean(loss, axis)
+
+    specs = ZeroTrainState(params=P(), model_state=P(), opt_state=P(axis),
+                           sync=P(axis), cm=P(axis), rng=P())
     mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
                                                            P(axis)),
                            out_specs=(specs, P()), check_vma=False)

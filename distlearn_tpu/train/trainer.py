@@ -65,18 +65,29 @@ def _sgd_update(params: PyTree, grads: PyTree, lr) -> PyTree:
         params, grads)
 
 
-def init_train_state(model: Model, tree: MeshTree, key: jax.Array,
-                     num_classes: int) -> TrainState:
+def init_common(model: Model, tree: MeshTree, key: jax.Array,
+                num_classes: int):
+    """Shared data-parallel state init: identical params on every node, a
+    per-node step counter (ref ``stepsPerNode``), a per-node confusion
+    matrix, and the training rng.  Returns
+    ``(params, model_state, sync, cm, rng)`` — the common fields of every
+    replicated-params TrainState flavor (SGD / optax / ZeRO)."""
     init_key, train_key = random.split(key)
     params, mstate = model.init(init_key)
     n = tree.num_nodes
-    return TrainState(
-        params=params, model_state=mstate,
-        sync=allreduce_sgd.SGDSyncState(
-            my_steps=tree.put_per_node(jnp.zeros((n,), jnp.int32))),
-        cm=tree.put_per_node(jnp.zeros((n, num_classes, num_classes),
-                                       jnp.int32)),
-        rng=train_key)
+    sync = allreduce_sgd.SGDSyncState(
+        my_steps=tree.put_per_node(jnp.zeros((n,), jnp.int32)))
+    cm = tree.put_per_node(jnp.zeros((n, num_classes, num_classes),
+                                     jnp.int32))
+    return params, mstate, sync, cm, train_key
+
+
+def init_train_state(model: Model, tree: MeshTree, key: jax.Array,
+                     num_classes: int) -> TrainState:
+    params, mstate, sync, cm, rng = init_common(model, tree, key,
+                                                num_classes)
+    return TrainState(params=params, model_state=mstate, sync=sync, cm=cm,
+                      rng=rng)
 
 
 def build_sgd_step(model: Model, tree: MeshTree, lr: float,
