@@ -135,12 +135,46 @@ def _pack_padded(spec, tree, total: int) -> jax.Array:
     return flat
 
 
+def _check_elementwise(tx, n: int):
+    """Probe that ``tx`` commutes with sharding: updating a vector in one
+    piece must equal updating its N chunks independently.  Catches
+    slice-coupling transforms (e.g. ``clip_by_global_norm``) that would
+    otherwise make ZeRO training silently diverge from the replicated-state
+    step — each shard would see only its own norm."""
+    # Multiple steps with DIRECTION-varying gradients: a one-step probe
+    # cannot catch e.g. clip_by_global_norm->adam (adam cancels any
+    # per-step uniform scale); across steps the shard-vs-full clip ratios
+    # vary and the divergence shows.
+    m = 8 * n
+    p = jnp.linspace(-1.0, 1.0, m, dtype=jnp.float32)
+    gs = [jnp.sin(jnp.arange(m, dtype=jnp.float32) * (0.3 + t))
+          * (2.0 + 3.0 * t) for t in range(3)]
+    state, pf = tx.init(p), p
+    for g in gs:
+        u, state = tx.update(g, state, pf)
+        pf = pf + u
+    shards = []
+    for i in range(n):
+        sl = slice(i * 8, (i + 1) * 8)
+        s, pi = tx.init(p[sl]), p[sl]
+        for g in gs:
+            u, s = tx.update(g[sl], s, pi)
+            pi = pi + u
+        shards.append(pi)
+    if not jnp.allclose(pf, jnp.concatenate(shards), rtol=1e-6, atol=1e-6):
+        raise ValueError(
+            "optimizer is not elementwise (its update couples parameter "
+            "slices, e.g. a global-norm clip), so ZeRO sharding would "
+            "silently change the training math — use build_optax_step")
+
+
 def init_zero_state(model: Model, tree: MeshTree, tx, key: jax.Array,
                     num_classes: int) -> ZeroTrainState:
     from distlearn_tpu.train.trainer import init_common
     params, mstate, sync, cm, rng = init_common(model, tree, key,
                                                 num_classes)
     n = tree.num_nodes
+    _check_elementwise(tx, n)
     spec, total, chunk = _zero_layout(params, n)
     slices = _pack_padded(spec, params, total).reshape(n, chunk)
     per_dev = [tx.init(slices[i]) for i in range(n)]

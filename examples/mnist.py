@@ -27,6 +27,9 @@ def main():
         "reportEvery": (100, "steps between confusion-matrix reports"),
         "parity": (False, "print a final JSON accuracy line "
                           "(BASELINE.md accuracy-parity harness)"),
+        "optimizer": ("sgd", "sgd (reference parity, fused Pallas path) | "
+                             "momentum | adam | adam-zero1 (optimizer "
+                             "state sharded over the nodes)"),
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -55,9 +58,32 @@ def main():
     ds = make_dataset(x, y, nc)
 
     model = mnist_cnn()
-    ts = init_train_state(model, tree, random.PRNGKey(opt.seed), nc)
-    step = build_sgd_step(model, tree, lr=opt.learningRate)
-    sync = build_sync_step(tree)
+    if opt.optimizer == "sgd":      # reference cadence (mnist.lua:112-116)
+        ts = init_train_state(model, tree, random.PRNGKey(opt.seed), nc)
+        step = build_sgd_step(model, tree, lr=opt.learningRate)
+    else:                           # the reference's `optim` slot -> optax
+        import optax
+
+        from distlearn_tpu.train import (build_optax_step,
+                                         build_zero_optax_step,
+                                         init_optax_state, init_zero_state)
+        txs = {"momentum": lambda: optax.sgd(opt.learningRate, momentum=0.9),
+               "adam": lambda: optax.adam(opt.learningRate),
+               "adam-zero1": lambda: optax.adam(opt.learningRate)}
+        if opt.optimizer not in txs:
+            raise SystemExit(f"unknown --optimizer {opt.optimizer!r} "
+                             f"(choose sgd, {', '.join(txs)})")
+        tx = txs[opt.optimizer]()
+        if opt.optimizer == "adam-zero1":
+            ts = init_zero_state(model, tree, tx, random.PRNGKey(opt.seed), nc)
+            step = build_zero_optax_step(model, tree, tx)
+        else:
+            ts = init_optax_state(model, tree, tx, random.PRNGKey(opt.seed), nc)
+            step = build_optax_step(model, tree, tx)
+    # winner-takes-all epoch sync is the uneven-participation repair; these
+    # full-participation runs keep params replicated, so it is an identity
+    # for the optax paths (and their state shape differs from TrainState)
+    sync = build_sync_step(tree) if opt.optimizer == "sgd" else (lambda s: s)
 
     timer = StepTimer()
     global_step = 0

@@ -117,3 +117,13 @@ def test_zero_rejects_non_f32_params():
                 input_shape=(4,), num_classes=2)
     with pytest.raises(ValueError, match="f32"):
         init_zero_state(bad, tree, optax.adam(1e-3), random.PRNGKey(0), 2)
+
+
+def test_zero_rejects_slice_coupling_optimizer():
+    import pytest
+    from distlearn_tpu.train import init_zero_state
+
+    tree, model, nc, _, _ = _setup()
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+    with pytest.raises(ValueError, match="not elementwise"):
+        init_zero_state(model, tree, tx, random.PRNGKey(0), nc)
