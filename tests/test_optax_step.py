@@ -127,3 +127,43 @@ def test_zero_rejects_slice_coupling_optimizer():
     tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
     with pytest.raises(ValueError, match="not elementwise"):
         init_zero_state(model, tree, tx, random.PRNGKey(0), nc)
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=k on a BN-free model must match the single-shot step to
+    float tolerance (same effective batch, same psum'd gradient)."""
+    tree, model, nc, bx, by = _setup(n=4, batch=16)
+    tx = optax.sgd(0.1)
+    ts1 = init_optax_state(model, tree, tx, random.PRNGKey(5), nc)
+    ts2 = init_optax_state(model, tree, tx, random.PRNGKey(5), nc)
+    full = build_optax_step(model, tree, tx)
+    accum = build_optax_step(model, tree, tx, accum_steps=2)
+    for _ in range(2):
+        ts1, l1 = full(ts1, bx, by)
+        ts2, l2 = accum(ts2, bx, by)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ts1.params),
+                    jax.tree_util.tree_leaves(ts2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # confusion matrices identical: every example was still counted once
+    np.testing.assert_array_equal(np.asarray(ts1.cm), np.asarray(ts2.cm))
+
+
+def test_gradient_accumulation_rejects_indivisible():
+    import pytest
+
+    tree, model, nc, bx, by = _setup(n=4, batch=16)  # 4 per device
+    tx = optax.sgd(0.1)
+    ts = init_optax_state(model, tree, tx, random.PRNGKey(6), nc)
+    step = build_optax_step(model, tree, tx, accum_steps=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(ts, bx, by)
+
+
+def test_accum_steps_validated_at_build():
+    import pytest
+
+    tree, model, _, _, _ = _setup()
+    with pytest.raises(ValueError, match="accum_steps must be"):
+        build_optax_step(model, tree, optax.sgd(0.1), accum_steps=0)
