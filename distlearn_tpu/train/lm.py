@@ -20,8 +20,8 @@ from distlearn_tpu.models.transformer import lm_loss, param_specs
 def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                   data_axis: str = "data", seq_axis: str | None = "seq",
                   tp_axis: str | None = "model",
-                  ep_axis: str | None = None, donate: bool = True
-                  ) -> Callable:
+                  ep_axis: str | None = None, accum_steps: int = 1,
+                  donate: bool = True) -> Callable:
     """``step(params, tokens) -> (params, loss)``.
 
     ``tokens``: [global_B, global_L] int32, sharded (data, seq).
@@ -39,7 +39,19 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
     experts' gradients.  They still reduce over ``seq_axis`` (each
     sequence shard routes its own tokens) and share the 1/dp objective
     scaling.
+
+    ``accum_steps=k`` splits each device's batch rows into ``k``
+    microbatches scanned sequentially (live activation memory drops ~k-
+    fold — composes with the model's ``remat``); the averaged gradient
+    feeds the same single reduction + update, so the effective batch is
+    unchanged and dense models match the single-shot step exactly (the
+    transformer has no dropout state).  MoE models are the exception:
+    expert capacity is computed per ROUTING CALL, so microbatching rounds
+    bucket sizes and decides overflow drops per microbatch — training is
+    still correct, but not bit-identical to the single-shot step.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
     # expert leaves reduce over every replicated axis EXCEPT the one that
     # shards them — summing across ep_axis would mix different experts
@@ -52,10 +64,33 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
         # differentiate the LOCAL loss share (reduce=False): see lm_loss —
         # psum transposes to psum under shard_map, so the global psum'd loss
         # must not sit inside the differentiated function
-        local_loss, grads = jax.value_and_grad(
-            lambda p: lm_loss(model, p, tokens, seq_axis=seq_axis,
-                              tp_axis=tp_axis, ep_axis=ep_axis,
-                              reduce=False))(params)
+        def local_grad(toks):
+            return jax.value_and_grad(
+                lambda p: lm_loss(model, p, toks, seq_axis=seq_axis,
+                                  tp_axis=tp_axis, ep_axis=ep_axis,
+                                  reduce=False))(params)
+
+        if accum_steps == 1:
+            local_loss, grads = local_grad(tokens)
+        else:
+            if tokens.shape[0] % accum_steps:
+                raise ValueError(
+                    f"per-device batch {tokens.shape[0]} not divisible by "
+                    f"accum_steps={accum_steps}")
+            micro = tokens.reshape((accum_steps, -1) + tokens.shape[1:])
+
+            def body(carry, toks):
+                acc_l, acc_g = carry
+                li, gi = local_grad(toks)
+                return (acc_l + li,
+                        jax.tree_util.tree_map(jnp.add, acc_g, gi)), None
+
+            zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (acc_l, acc_g), _ = lax.scan(
+                body, (jnp.zeros((), jnp.float32), zero), micro)
+            local_loss = acc_l / jnp.float32(accum_steps)
+            grads = jax.tree_util.tree_map(
+                lambda g: g / jnp.asarray(accum_steps, g.dtype), acc_g)
         loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
         # Sum partial grads over seq (params replicated there, each shard
         # holds part of the chain) and AVERAGE over data (the global

@@ -39,6 +39,8 @@ def main():
         "moeExperts": (0, "experts per MoE block (0 = dense; must equal "
                           "--dp, experts shard over the data axis)"),
         "remat": (False, "jax.checkpoint each block (long-context memory)"),
+        "accumSteps": (1, "gradient-accumulation microbatches per step "
+                          "(memory lever; effective batch unchanged)"),
         "profile": ("", "capture a jax.profiler trace of steps 6..10 into "
                         "this directory (view in TensorBoard/Perfetto)"),
         "bf16": (False, "bfloat16 compute"),
@@ -85,7 +87,7 @@ def main():
     params, _ = lm.init(random.PRNGKey(opt.seed))
     ep_axis = "data" if opt.moeExperts else None
     step = build_lm_step(lm, mesh, params, lr=opt.learningRate,
-                         ep_axis=ep_axis)
+                         ep_axis=ep_axis, accum_steps=opt.accumSteps)
     params = jax.device_put(
         params, jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s),

@@ -1,6 +1,7 @@
 """3D-parallel LM train step: loss decreases; TP shards update consistently."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -74,3 +75,36 @@ def test_lm_step_dp_only_matches_structure():
     # structure preserved
     assert jax.tree_util.tree_structure(new_params) == \
         jax.tree_util.tree_structure(params)
+
+
+def test_lm_gradient_accumulation_matches_full():
+    """accum_steps=2 must reproduce the single-shot LM step exactly (the
+    transformer is deterministic — no dropout)."""
+    import numpy as np
+    from jax import random
+
+    from distlearn_tpu.models.transformer import param_specs, transformer_lm
+    from distlearn_tpu.train.lm import build_lm_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2, 1),
+                ("data", "seq", "model"))
+    lm = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=16)
+    params, _ = lm.init(random.PRNGKey(0))
+    toks = jax.device_put(
+        jnp.asarray(np.random.RandomState(1).randint(0, 64, (8, 16)),
+                    jnp.int32),
+        NamedSharding(mesh, P("data", "seq")))
+    sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s),
+                                param_specs(params, tp_axis="model"))
+    outs = {}
+    for k in (1, 2):
+        step = build_lm_step(lm, mesh, params, lr=0.1, accum_steps=k,
+                             donate=False)
+        p = jax.device_put(params, sh)
+        for _ in range(2):
+            p, loss = step(p, toks)
+        outs[k] = (float(loss), jax.tree_util.tree_leaves(p))
+    np.testing.assert_allclose(outs[1][0], outs[2][0], rtol=1e-6)
+    for a, b in zip(outs[1][1], outs[2][1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
