@@ -21,6 +21,10 @@ def main():
         **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
         "numSyncs": (0, "total syncs to serve (0 = numEpochs*steps/tau per node)"),
         "tester": (False, "open the test channel and expect a tester process"),
+        "syncTimeout": (0.0, "max seconds to wait for any sync request "
+                             "before stopping the serve loop (0 = wait "
+                             "forever, the reference's behavior — set it "
+                             "when clients may die without cleanup)"),
     })
     setup_platform(1, opt.tpu)
 
@@ -46,8 +50,18 @@ def main():
     srv = AsyncEAServer(opt.host, opt.port, opt.numNodes,
                         with_tester=opt.tester)
     srv.init_server(params)
+    served = 0
     for i in range(1, num_syncs + 1):
-        params = srv.sync_server(params)
+        try:
+            params = srv.sync_server(params,
+                                     timeout=opt.syncTimeout or None)
+        except (TimeoutError, RuntimeError) as e:
+            # evicted/finished clients can leave fewer syncs than the
+            # expected count — stop serving instead of wedging (the
+            # reference would hang here); RuntimeError = every client gone
+            print_server(f"stopping serve loop after {served} syncs: {e!r}")
+            break
+        served = i
         if opt.tester and i % opt.testTime == 0:
             srv.test_net()
         if opt.save and i % (opt.testTime * 2) == 0:
@@ -55,7 +69,9 @@ def main():
     if opt.tester:
         srv.test_net()  # final eval push
     if opt.save:
-        ckpt.save_checkpoint(opt.save, num_syncs, {"center": params})
+        # stamped with the count actually served: an early stop must not
+        # masquerade as a fully-served run
+        ckpt.save_checkpoint(opt.save, served, {"center": params})
     print_server("done")
     srv.close()
 
