@@ -3,9 +3,10 @@ collapsed into single XLA programs)."""
 
 from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          init_train_state, init_ea_state,
-                                         build_sgd_step, build_sync_step,
+                                         build_sgd_step, build_sgd_scan_step,
+                                         build_sync_step,
                                          build_eval_step, build_ea_steps,
-                                         reduce_confusion)
+                                         build_ea_cycle, reduce_confusion)
 from distlearn_tpu.train.lm import build_lm_step
 from distlearn_tpu.train.optim import (OptaxTrainState, ZeroTrainState,
                                        build_optax_step,
@@ -14,7 +15,8 @@ from distlearn_tpu.train.optim import (OptaxTrainState, ZeroTrainState,
 
 __all__ = [
     "TrainState", "EATrainState", "init_train_state", "init_ea_state",
-    "build_sgd_step", "build_sync_step", "build_eval_step", "build_ea_steps",
+    "build_sgd_step", "build_sgd_scan_step", "build_sync_step",
+    "build_eval_step", "build_ea_steps", "build_ea_cycle",
     "reduce_confusion", "build_lm_step",
     "OptaxTrainState", "build_optax_step", "init_optax_state",
     "ZeroTrainState", "build_zero_optax_step", "init_zero_state",

@@ -120,6 +120,30 @@ def build_sgd_step(model: Model, tree: MeshTree, lr: float,
     ``max_bucket_bytes`` splits huge models into several buckets.
     """
     axis = tree.axis_name
+    _body = _make_sgd_body(model, tree, lr, fused, max_bucket_bytes)
+
+    specs_ts = TrainState(params=P(), model_state=P(), sync=P(axis),
+                          cm=P(axis), rng=P())
+    if with_contrib:
+        def step(ts, x, y, contrib):
+            return _body(ts, x, y, jnp.squeeze(contrib, 0))
+        in_specs = (specs_ts, P(axis), P(axis), P(axis))
+    else:
+        def step(ts, x, y):
+            return _body(ts, x, y, None)
+        in_specs = (specs_ts, P(axis), P(axis))
+    mapped = jax.shard_map(step, mesh=tree.mesh,
+                           in_specs=in_specs,
+                           out_specs=(specs_ts, P()),
+                           check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def _make_sgd_body(model: Model, tree: MeshTree, lr: float,
+                   fused: bool | None, max_bucket_bytes: int | None):
+    """The per-node body of one fused AllReduceSGD step (shared by the
+    per-call and the scanned builders)."""
+    axis = tree.axis_name
     use_fused = fused_update.fused_enabled(fused)
 
     def _body(ts: TrainState, x, y, contrib):
@@ -156,18 +180,41 @@ def build_sgd_step(model: Model, tree: MeshTree, lr: float,
             mean_loss = lax.pmean(loss, axis)
         return TrainState(params, mstate, sync, cm_new[None], rng), mean_loss
 
+    return _body
+
+
+def build_sgd_scan_step(model: Model, tree: MeshTree, lr: float,
+                        donate: bool = True, fused: bool | None = None,
+                        max_bucket_bytes: int | None = None) -> Callable:
+    """K chained AllReduceSGD steps as ONE XLA program:
+    ``steps(ts, xs, ys) -> (ts, losses)`` with ``xs``/``ys`` carrying a
+    leading ``[K]`` step axis (replicated) over the normal data-sharded batch
+    axes, ``losses`` shaped ``[K]``.
+
+    Semantically identical to calling :func:`build_sgd_step`'s step K times
+    (same psum/normalize/update per step, state threads through a
+    ``lax.scan``), but the host dispatches ONCE per K steps.  On a
+    remote-attached chip the per-call dispatch round trip can exceed the
+    step's compute (measured ~3 ms dispatch vs ~1.3 ms compute for the
+    CIFAR-10 headline step) — the reference has the same structure cost in
+    every ``tree.allReduce`` socket round trip (SURVEY.md §3.1), which this
+    design removes entirely.  K is read from the input shape at trace time.
+    """
+    axis = tree.axis_name
+    _body = _make_sgd_body(model, tree, lr, fused, max_bucket_bytes)
+
+    def steps(ts, xs, ys):
+        def scan_body(carry, xy):
+            x, y = xy
+            new_ts, loss = _body(carry, x, y, None)
+            return new_ts, loss
+        ts, losses = lax.scan(scan_body, ts, (xs, ys))
+        return ts, losses
+
     specs_ts = TrainState(params=P(), model_state=P(), sync=P(axis),
                           cm=P(axis), rng=P())
-    if with_contrib:
-        def step(ts, x, y, contrib):
-            return _body(ts, x, y, jnp.squeeze(contrib, 0))
-        in_specs = (specs_ts, P(axis), P(axis), P(axis))
-    else:
-        def step(ts, x, y):
-            return _body(ts, x, y, None)
-        in_specs = (specs_ts, P(axis), P(axis))
-    mapped = jax.shard_map(step, mesh=tree.mesh,
-                           in_specs=in_specs,
+    mapped = jax.shard_map(steps, mesh=tree.mesh,
+                           in_specs=(specs_ts, P(None, axis), P(None, axis)),
                            out_specs=(specs_ts, P()),
                            check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -227,10 +274,13 @@ def reduce_confusion(cm: jax.Array):
 
 class EATrainState(NamedTuple):
     """Per-node training state for EASGD — every leaf has a leading
-    ``num_nodes`` axis sharded over the data mesh axis (nodes diverge)."""
+    ``num_nodes`` axis sharded over the data mesh axis (nodes diverge).
+    ``vel`` is the per-node momentum buffer (EAMSGD, arXiv:1412.6651 §3);
+    zeros and untouched when the local optimizer is plain SGD."""
     params: PyTree
     model_state: PyTree
     center: PyTree
+    vel: PyTree
     cm: jax.Array
     rng: jax.Array
 
@@ -238,7 +288,8 @@ class EATrainState(NamedTuple):
 def init_ea_state(model: Model, tree: MeshTree, key: jax.Array,
                   num_classes: int) -> EATrainState:
     """Identical init on every node (ref seed-0 + initial scatter —
-    examples/mnist-ea.lua:63), center := params (lua/AllReduceEA.lua:11-22)."""
+    examples/mnist-ea.lua:63), center := params (lua/AllReduceEA.lua:11-22),
+    zero momentum."""
     init_key, train_key = random.split(key)
     params, mstate = model.init(init_key)
     n = tree.num_nodes
@@ -249,14 +300,15 @@ def init_ea_state(model: Model, tree: MeshTree, key: jax.Array,
     return EATrainState(
         params=params_n, model_state=stack(mstate),
         center=stack(params),
+        vel=stack(jax.tree_util.tree_map(jnp.zeros_like, params)),
         cm=tree.put_per_node(jnp.zeros((n, num_classes, num_classes), jnp.int32)),
         rng=tree.put_per_node(rngs))
 
 
 def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
                    donate: bool = True, fused: bool | None = None,
-                   max_bucket_bytes: int | None = None
-                   ) -> tuple[Callable, Callable]:
+                   max_bucket_bytes: int | None = None,
+                   momentum: float = 0.0) -> tuple[Callable, Callable]:
     """Returns ``(local_step, ea_round)``.
 
     ``local_step(ts, x, y) -> (ts, losses)`` — grad + local SGD, ZERO
@@ -269,7 +321,36 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
     (default on TPU) the round runs on packed flat buckets: one Pallas
     kernel produces (p', delta) and ONE psum per bucket carries the deltas,
     instead of a collective per parameter leaf.
+
+    ``momentum > 0`` switches the local optimizer to heavy-ball SGD —
+    **EAMSGD** from the EASGD paper (arXiv:1412.6651 §3, the variant the
+    reference never implemented): ``v = μ·v + g; p -= lr·v`` per quiet
+    step, elastic round unchanged.  (torch-optim parameterization; the
+    paper's ``v = δv − ηg; x += v`` is the same update with ``v`` rescaled
+    by ``−η``.)
     """
+    local_step, ea_round = _make_ea_bodies(model, tree, lr, alpha, fused,
+                                           max_bucket_bytes, momentum)
+    axis = tree.axis_name
+    spec_ts = EATrainState(params=P(axis), model_state=P(axis), center=P(axis),
+                           vel=P(axis), cm=P(axis), rng=P(axis))
+    local = jax.jit(
+        jax.shard_map(local_step, mesh=tree.mesh,
+                      in_specs=(spec_ts, P(axis), P(axis)),
+                      out_specs=(spec_ts, P(axis)), check_vma=False),
+        donate_argnums=(0,) if donate else ())
+    rnd = jax.jit(
+        jax.shard_map(ea_round, mesh=tree.mesh, in_specs=(spec_ts,),
+                      out_specs=spec_ts, check_vma=False),
+        donate_argnums=(0,) if donate else ())
+    return local, rnd
+
+
+def _make_ea_bodies(model: Model, tree: MeshTree, lr: float, alpha: float,
+                    fused: bool | None, max_bucket_bytes: int | None,
+                    momentum: float = 0.0):
+    """Per-node (local_step, ea_round) bodies shared by the per-call and the
+    scanned EASGD builders."""
     axis = tree.axis_name
     use_fused = fused_update.fused_enabled(fused)
     _sq, _ex = mesh_lib.squeeze_node, mesh_lib.expand_node
@@ -285,10 +366,21 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
 
         (loss, (log_probs, mstate)), grads = \
             jax.value_and_grad(_loss, has_aux=True)(params)
-        params = _sgd_update(params, grads, lr)
+        vel = ts.vel
+        if momentum:
+            # EAMSGD local rule (arXiv:1412.6651 §3): heavy-ball velocity.
+            v = jax.tree_util.tree_map(
+                lambda v, g: jnp.asarray(momentum, v.dtype) * v
+                + g.astype(v.dtype), _sq(ts.vel), grads)
+            params = jax.tree_util.tree_map(
+                lambda p, v: p - jnp.asarray(lr, p.dtype) * v.astype(p.dtype),
+                params, v)
+            vel = _ex(v)
+        else:
+            params = _sgd_update(params, grads, lr)
         cm = metrics_lib.update_confusion(cm, log_probs, y)
-        new_ts = EATrainState(_ex(params), _ex(mstate), ts.center, _ex(cm),
-                              _ex(rng))
+        new_ts = EATrainState(_ex(params), _ex(mstate), ts.center, vel,
+                              _ex(cm), _ex(rng))
         return new_ts, loss[None] if loss.ndim == 0 else loss
 
     def ea_round(ts: EATrainState):
@@ -303,17 +395,42 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
                                                     axis_name=axis)
             center = st.center
         return EATrainState(_ex(params), ts.model_state, _ex(center),
-                            ts.cm, ts.rng)
+                            ts.vel, ts.cm, ts.rng)
+
+    return local_step, ea_round
+
+
+def build_ea_cycle(model: Model, tree: MeshTree, lr: float, alpha: float,
+                   donate: bool = True, fused: bool | None = None,
+                   max_bucket_bytes: int | None = None,
+                   momentum: float = 0.0) -> Callable:
+    """One full EASGD cycle — τ collective-free local steps then the fused
+    elastic round — as ONE XLA program: ``cycle(ts, xs, ys) -> (ts, losses)``
+    with ``xs``/``ys`` carrying a leading ``[tau]`` step axis and ``losses``
+    shaped ``[tau, num_nodes]``.
+
+    This is the EASGD communication structure itself (τ−1 quiet steps per
+    round, lua/AllReduceEA.lua:31 / examples/mnist-ea.lua:110) compiled into
+    a single dispatch: the host talks to the device once per *round*, not
+    once per step, and XLA schedules the round's psum right after the last
+    local update.  τ is read from the input shape at trace time.
+    """
+    local_step, ea_round = _make_ea_bodies(model, tree, lr, alpha, fused,
+                                           max_bucket_bytes, momentum)
+    axis = tree.axis_name
+
+    def cycle(ts, xs, ys):
+        def scan_body(carry, xy):
+            x, y = xy
+            new_ts, loss = local_step(carry, x, y)
+            return new_ts, loss
+        ts, losses = lax.scan(scan_body, ts, (xs, ys))
+        return ea_round(ts), losses
 
     spec_ts = EATrainState(params=P(axis), model_state=P(axis), center=P(axis),
-                           cm=P(axis), rng=P(axis))
-    local = jax.jit(
-        jax.shard_map(local_step, mesh=tree.mesh,
-                      in_specs=(spec_ts, P(axis), P(axis)),
-                      out_specs=(spec_ts, P(axis)), check_vma=False),
-        donate_argnums=(0,) if donate else ())
-    rnd = jax.jit(
-        jax.shard_map(ea_round, mesh=tree.mesh, in_specs=(spec_ts,),
-                      out_specs=spec_ts, check_vma=False),
-        donate_argnums=(0,) if donate else ())
-    return local, rnd
+                           vel=P(axis), cm=P(axis), rng=P(axis))
+    mapped = jax.shard_map(cycle, mesh=tree.mesh,
+                           in_specs=(spec_ts, P(None, axis), P(None, axis)),
+                           out_specs=(spec_ts, P(None, axis)),
+                           check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())

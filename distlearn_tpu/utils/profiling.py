@@ -36,10 +36,13 @@ class StepTimer:
         self._times: list[float] = []
         self._last: float | None = None
 
-    def tick(self):
+    def tick(self, steps: int = 1):
+        """``steps``: how many training steps the interval since the last
+        tick covered (>1 for the scanned multi-step trainers); the recorded
+        interval is normalized to per-step time."""
         now = time.perf_counter()
         if self._last is not None:
-            self._times.append(now - self._last)
+            self._times.append((now - self._last) / max(1, steps))
         self._last = now
 
     def reset_window(self):

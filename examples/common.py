@@ -65,3 +65,29 @@ def device_stream(tree, ds, sampler, batch, prefetch=2):
     sh = data_sharding(tree)
     return prefetch_to_device(batch_iterator(ds, sampler, batch),
                               size=prefetch, sharding=sh)
+
+
+def device_stream_stacked(tree, ds, sampler, batch, k, prefetch=2):
+    """Group ``k`` consecutive batches into one ``[k, B, ...]`` super-batch
+    for the scanned trainers (``train.build_sgd_scan_step`` /
+    ``train.build_ea_cycle``): the step axis is replicated, the batch axis
+    sharded over the mesh.  A shorter final group is yielded as-is (the scan
+    reads its length from the shape; one extra compile per distinct length).
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.data import batch_iterator, prefetch_to_device
+    sh = NamedSharding(tree.mesh, P(None, tree.axis_name))
+
+    def groups():
+        xs, ys = [], []
+        for bx, by in batch_iterator(ds, sampler, batch):
+            xs.append(bx)
+            ys.append(by)
+            if len(xs) == k:
+                yield np.stack(xs), np.stack(ys)
+                xs, ys = [], []
+        if xs:
+            yield np.stack(xs), np.stack(ys)
+    return prefetch_to_device(groups(), size=prefetch, sharding=sh)

@@ -13,7 +13,8 @@ Run:  python examples/mnist_ea.py --numNodes 4 [--tpu]
 
 from __future__ import annotations
 
-from common import setup_platform, resolve_num_nodes, device_stream
+from common import (setup_platform, resolve_num_nodes, device_stream,
+                    device_stream_stacked)
 from distlearn_tpu.utils.flags import (parse_flags, CKPT_FLAGS, NODE_FLAGS,
                                        TRAIN_FLAGS, EA_FLAGS)
 
@@ -27,6 +28,12 @@ def main():
         "data": ("", "path to .npz (default: synthetic)"),
         "numExamples": (4096, "synthetic dataset size"),
         "reportEvery": (100, "steps between reports"),
+        "scanCycle": (False, "run each tau-step EASGD cycle as ONE XLA "
+                             "program (build_ea_cycle) — amortizes host "
+                             "dispatch on remote-attached chips"),
+        "momentum": (0.0, "local heavy-ball momentum — EAMSGD "
+                          "(arXiv:1412.6651 §3); 0 = plain EASGD "
+                          "(the reference)"),
         **CKPT_FLAGS,
     })
     setup_platform(opt.numNodes, opt.tpu)
@@ -40,8 +47,8 @@ def main():
     from distlearn_tpu.models import mnist_cnn
     from distlearn_tpu.parallel import allreduce_ea
     from distlearn_tpu.parallel.mesh import MeshTree
-    from distlearn_tpu.train import (build_ea_steps, init_ea_state,
-                                     reduce_confusion)
+    from distlearn_tpu.train import (build_ea_cycle, build_ea_steps,
+                                     init_ea_state, reduce_confusion)
     from distlearn_tpu.utils import checkpoint as ckpt
     from distlearn_tpu.utils import metrics as M
     from distlearn_tpu.utils.logging import root_print
@@ -60,40 +67,70 @@ def main():
     model = mnist_cnn()
     ets = init_ea_state(model, tree, random.PRNGKey(opt.seed), nc)
     local_step, ea_round = build_ea_steps(model, tree, lr=opt.learningRate,
-                                          alpha=opt.alpha)
+                                          alpha=opt.alpha,
+                                          momentum=opt.momentum)
     tau = opt.communicationTime
 
     start_epoch = 1
     global_step = 0
     if opt.resume and opt.save and ckpt.latest_step(opt.save) is not None:
         restorable = {"params": ets.params, "model_state": ets.model_state,
-                      "center": ets.center}
-        restored, meta = ckpt.restore_checkpoint(opt.save, restorable)
+                      "center": ets.center, "vel": ets.vel}
+        try:
+            restored, meta = ckpt.restore_checkpoint(opt.save, restorable)
+        except KeyError:
+            # pre-EAMSGD checkpoint without a velocity buffer: momentum
+            # restarts from zero (ets.vel is already zeros)
+            restorable.pop("vel")
+            restored, meta = ckpt.restore_checkpoint(opt.save, restorable)
+            restored["vel"] = None
         # re-place host arrays onto the mesh (stacked per-node sharding)
         ets = ets._replace(params=tree.put_per_node(restored["params"]),
                            model_state=tree.put_per_node(
                                restored["model_state"]),
-                           center=tree.put_per_node(restored["center"]))
+                           center=tree.put_per_node(restored["center"]),
+                           vel=(tree.put_per_node(restored["vel"])
+                                if restored["vel"] is not None else ets.vel))
         start_epoch = meta["step"] + 1
         # resume the step counter too: the tau-spaced elastic-round cadence
         # must continue in phase with the uninterrupted run
         global_step = int(meta.get("global_step", 0))
         log(f"resumed from epoch {meta['step']} (step {global_step})")
 
+    cycle = (build_ea_cycle(model, tree, lr=opt.learningRate, alpha=opt.alpha,
+                            momentum=opt.momentum) if opt.scanCycle else None)
     timer = StepTimer()
     for epoch in range(start_epoch, opt.numEpochs + 1):
         sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
-        for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
+        if opt.scanCycle:
+            # τ local steps + elastic round per dispatch; a shorter final
+            # group ends the epoch with an early round (the epoch-end
+            # synchronizeCenter below follows it anyway).
+            timer.reset_window()   # prime: first interval starts here
             timer.tick()
-            ets, losses = local_step(ets, bx, by)
-            global_step += 1
-            if global_step % tau == 0:       # mnist-ea.lua:110 cadence
-                ets = ea_round(ets)
-            if global_step % opt.reportEvery == 0:
-                cm = reduce_confusion(ets.cm)
-                log(f"step {global_step} loss "
-                    f"{float(np.mean(np.asarray(losses))):.4f} "
-                    f"{M.format_confusion(cm)}")
+            for sxs, sys_ in device_stream_stacked(tree, ds, sampler,
+                                                   opt.batchSize, tau):
+                k = sxs.shape[0]
+                ets, losses = cycle(ets, sxs, sys_)
+                timer.tick(steps=k)   # interval since last tick = this cycle
+                global_step += k
+                if (global_step // tau) % max(1, opt.reportEvery // tau) == 0:
+                    cm = reduce_confusion(ets.cm)
+                    log(f"step {global_step} loss "
+                        f"{float(np.mean(np.asarray(losses))):.4f} "
+                        f"{M.format_confusion(cm)}")
+        else:
+            for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
+                timer.tick()
+                ets, losses = local_step(ets, bx, by)
+                global_step += 1
+                if global_step % tau == 0:       # mnist-ea.lua:110 cadence
+                    ets = ea_round(ets)
+                if global_step % opt.reportEvery == 0:
+                    cm = reduce_confusion(ets.cm)
+                    log(f"step {global_step} loss "
+                        f"{float(np.mean(np.asarray(losses))):.4f} "
+                        f"{M.format_confusion(cm)}")
         # end-of-epoch synchronizeCenter (mnist-ea.lua:121): broadcast node
         # 0's center replica — deterministic psums keep replicas identical,
         # this is the multi-host drift repair (lua/AllReduceEA.lua:74-84)
@@ -105,9 +142,10 @@ def main():
             ckpt.save_checkpoint(
                 opt.save, epoch,
                 {"params": ets.params, "model_state": ets.model_state,
-                 "center": ets.center},
+                 "center": ets.center, "vel": ets.vel},
                 metadata={"epoch": epoch, "global_step": global_step,
-                          "tau": tau, "alpha": opt.alpha})
+                          "tau": tau, "alpha": opt.alpha,
+                          "momentum": opt.momentum})
     jax.block_until_ready(ets.params)
     log("done")
 

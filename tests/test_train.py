@@ -12,7 +12,8 @@ from distlearn_tpu.data import (PermutationSampler, batch_iterator,
                                 make_dataset, synthetic_mnist)
 from distlearn_tpu.models import mnist_cnn
 from distlearn_tpu.parallel.mesh import MeshTree
-from distlearn_tpu.train import (build_ea_steps, build_eval_step,
+from distlearn_tpu.train import (build_ea_cycle, build_ea_steps,
+                                 build_eval_step, build_sgd_scan_step,
                                  build_sgd_step, build_sync_step,
                                  init_ea_state, init_train_state,
                                  reduce_confusion)
@@ -128,6 +129,87 @@ def test_contrib_masks_batchnorm_stats():
     np.testing.assert_allclose(m1, m2, rtol=1e-6)
 
 
+def _stacked_batches(tree, k, batch=32, seed=0):
+    """k distinct batches stacked along a leading step axis, plus the same
+    batches as a list (for the per-call reference path)."""
+    pairs = []
+    it = _data_stream(tree, n=k * batch, batch=batch, seed=seed)
+    for bx, by in it:
+        pairs.append((np.asarray(jax.device_get(bx)),
+                      np.asarray(jax.device_get(by))))
+    pairs = pairs[:k]
+    xs = np.stack([p[0] for p in pairs])
+    ys = np.stack([p[1] for p in pairs])
+    sh = NamedSharding(tree.mesh, P(None, "data"))
+    return jax.device_put(xs, sh), jax.device_put(ys, sh), pairs
+
+
+def test_sgd_scan_step_matches_per_call_steps():
+    """build_sgd_scan_step(K steps in one XLA program) must produce the same
+    trajectory as K calls of build_sgd_step — same psum order, same update."""
+    tree = MeshTree(num_nodes=4)
+    model = mnist_cnn()
+    k = 4
+    xs, ys, pairs = _stacked_batches(tree, k)
+    sh = NamedSharding(tree.mesh, P("data"))
+
+    ts_ref = init_train_state(model, tree, random.PRNGKey(0), 10)
+    step = build_sgd_step(model, tree, lr=0.1, donate=False)
+    ref_losses = []
+    for bx, by in pairs:
+        ts_ref, loss = step(ts_ref, jax.device_put(bx, sh),
+                            jax.device_put(by, sh))
+        ref_losses.append(float(loss))
+
+    ts = init_train_state(model, tree, random.PRNGKey(0), 10)
+    scan_step = build_sgd_scan_step(model, tree, lr=0.1, donate=False)
+    ts, losses = scan_step(ts, xs, ys)
+    assert losses.shape == (k,)
+    np.testing.assert_allclose(np.asarray(jax.device_get(losses)),
+                               np.asarray(ref_losses), rtol=1e-5, atol=1e-6)
+    ref_leaves = jax.tree_util.tree_leaves(jax.device_get(ts_ref.params))
+    got_leaves = jax.tree_util.tree_leaves(jax.device_get(ts.params))
+    for a, b in zip(ref_leaves, got_leaves):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # step counters / confusion matrices advance identically
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(ts.sync.my_steps)),
+        np.asarray(jax.device_get(ts_ref.sync.my_steps)))
+    np.testing.assert_array_equal(reduce_confusion(ts.cm),
+                                  reduce_confusion(ts_ref.cm))
+
+
+def test_ea_cycle_matches_local_steps_plus_round():
+    """build_ea_cycle(τ local steps + elastic round, one dispatch) must match
+    τ local() calls followed by one rnd() call."""
+    tree = MeshTree(num_nodes=4)
+    model = mnist_cnn()
+    tau = 3
+    xs, ys, pairs = _stacked_batches(tree, tau, seed=1)
+    sh = NamedSharding(tree.mesh, P("data"))
+
+    ets_ref = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    local, rnd = build_ea_steps(model, tree, lr=0.1, alpha=0.25, donate=False)
+    for bx, by in pairs:
+        ets_ref, _ = local(ets_ref, jax.device_put(bx, sh),
+                           jax.device_put(by, sh))
+    ets_ref = rnd(ets_ref)
+
+    ets = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    cycle = build_ea_cycle(model, tree, lr=0.1, alpha=0.25, donate=False)
+    ets, losses = cycle(ets, xs, ys)
+    assert losses.shape == (tau, 4)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ets_ref.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(ets.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ets_ref.center)),
+                    jax.tree_util.tree_leaves(jax.device_get(ets.center))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_ea_local_steps_diverge_then_round_contracts():
     tree = MeshTree(num_nodes=4)
     model = mnist_cnn()
@@ -152,6 +234,51 @@ def test_ea_local_steps_diverge_then_round_contracts():
     arr = np.asarray(jax.device_get(c))
     for i in range(1, arr.shape[0]):
         np.testing.assert_array_equal(arr[0], arr[i])
+
+
+def test_eamsgd_momentum_local_steps():
+    """EAMSGD (arXiv:1412.6651 §3): with momentum the velocity buffer moves
+    and training converges; with momentum=0 velocity stays zero and the
+    trajectory matches plain EASGD bitwise."""
+    tree = MeshTree(num_nodes=4)
+    model = mnist_cnn()
+    xs, ys, pairs = _stacked_batches(tree, 3, seed=2)
+    sh = NamedSharding(tree.mesh, P("data"))
+
+    # momentum=0 path is bitwise the plain-EASGD path, vel untouched
+    e0 = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    l0, _ = build_ea_steps(model, tree, lr=0.1, alpha=0.25, donate=False)
+    em = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    lm, _ = build_ea_steps(model, tree, lr=0.1, alpha=0.25, donate=False,
+                           momentum=0.0)
+    for bx, by in pairs:
+        bx, by = jax.device_put(bx, sh), jax.device_put(by, sh)
+        e0, _ = l0(e0, bx, by)
+        em, _ = lm(em, bx, by)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(e0.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(em.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert all(float(np.abs(np.asarray(jax.device_get(v))).max()) == 0.0
+               for v in jax.tree_util.tree_leaves(em.vel))
+
+    # momentum>0: velocity becomes non-zero, loss still decreases over epochs
+    ets = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    local, rnd = build_ea_steps(model, tree, lr=0.05, alpha=0.2,
+                                momentum=0.9)
+    first = last = None
+    k = 0
+    for _ in range(3):
+        for bx, by in _data_stream(tree, seed=3):
+            ets, losses = local(ets, bx, by)
+            k += 1
+            if k % 10 == 0:
+                ets = rnd(ets)
+            m = float(np.mean(np.asarray(losses)))
+            first = m if first is None else first
+            last = m
+    assert last < first
+    assert any(float(np.abs(np.asarray(jax.device_get(v))).max()) > 0
+               for v in jax.tree_util.tree_leaves(ets.vel))
 
 
 def test_ea_training_converges():
