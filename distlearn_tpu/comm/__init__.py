@@ -5,5 +5,7 @@ plane for the asynchronous parameter-server path and multi-host side-channel.
 """
 
 from distlearn_tpu.comm.transport import Conn, Server, connect, ProtocolError
+from distlearn_tpu.comm.ring import LocalhostRing, Ring
 
-__all__ = ["Conn", "Server", "connect", "ProtocolError"]
+__all__ = ["Conn", "Server", "connect", "ProtocolError", "Ring",
+           "LocalhostRing"]
