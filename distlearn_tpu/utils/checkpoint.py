@@ -85,6 +85,68 @@ def latest_step(directory: str) -> int | None:
     return max(steps) if steps else None
 
 
+class AsyncCheckpointer:
+    """Non-blocking checkpointing: snapshot device state to host, then write
+    the npz on a worker thread so the train loop never stalls on filesystem
+    IO (the orbax ``async_checkpointer`` shape, dependency-free).
+
+    Semantics:
+
+    * :meth:`save` blocks only for the device→host transfer (the snapshot is
+      taken at call time — later param updates cannot tear it), then returns;
+      the atomic write + prune run on the worker.
+    * one in-flight write at a time: a second :meth:`save` first waits for
+      the previous write (backpressure rather than unbounded queueing);
+    * :meth:`wait` blocks until the last write is durable and re-raises any
+      worker error — call it before reading ``latest_step`` or exiting;
+    * use as a context manager to guarantee the final wait.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        import threading
+        self.directory = directory
+        self.keep = keep
+        self._thread: "threading.Thread | None" = None
+        self._err: list[BaseException] = []
+
+    def save(self, step: int, tree: PyTree,
+             metadata: dict | None = None) -> None:
+        import threading
+        self.wait()                      # backpressure + surface prior error
+        def _snapshot(x):
+            # device leaves: device_get already materializes a fresh host
+            # array; host numpy leaves come back as-is and must be copied
+            # or they would alias the caller's buffer and tear on mutation
+            a = jax.device_get(x)
+            return np.array(a) if a is x else np.asarray(a)
+
+        host_tree = jax.tree_util.tree_map(_snapshot, tree)
+
+        def _write():
+            try:
+                save_checkpoint(self.directory, step, host_tree,
+                                metadata=metadata, keep=self.keep)
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                self._err.append(e)
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._err:
+            raise self._err.pop(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        return False
+
+
 def restore_checkpoint(directory: str, like: PyTree, step: int | None = None
                        ) -> tuple[PyTree, dict]:
     """Restore into the structure of ``like`` (shape/dtype validated leaf by

@@ -82,25 +82,27 @@ def main():
         log(f"resumed from epoch {meta['step']}")
 
     timer = StepTimer()
-    for epoch in range(start_epoch, opt.numEpochs + 1):
-        sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
-        for i, (bx, by) in enumerate(
-                device_stream(tree, ds, sampler, opt.batchSize)):
-            timer.tick()
-            ts, loss = step(ts, bx, by)
-            if opt.stepsPerEpoch and i + 1 >= opt.stepsPerEpoch:
-                break
-        ts = sync(ts)
-        cm = reduce_confusion(ts.cm)
-        ts = ts._replace(cm=jax.tree_util.tree_map(lambda c: c * 0, ts.cm))
-        log(f"epoch {epoch}: loss {float(loss):.4f} "
-            f"train {M.format_confusion(cm)} "
-            f"({timer.steps_per_sec():.2f} steps/s)")
-        if opt.save:
-            ckpt.save_checkpoint(
-                opt.save, epoch,
-                {"params": ts.params, "model_state": ts.model_state},
-                metadata={"epoch": epoch})
+    # async writer: epoch N+1 trains while epoch N's npz hits disk (a
+    # ResNet-50 checkpoint is ~100 MB — a synchronous write stalls the mesh)
+    with ckpt.AsyncCheckpointer(opt.save or ".", keep=3) as saver:
+        for epoch in range(start_epoch, opt.numEpochs + 1):
+            sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
+            for i, (bx, by) in enumerate(
+                    device_stream(tree, ds, sampler, opt.batchSize)):
+                timer.tick()
+                ts, loss = step(ts, bx, by)
+                if opt.stepsPerEpoch and i + 1 >= opt.stepsPerEpoch:
+                    break
+            ts = sync(ts)
+            cm = reduce_confusion(ts.cm)
+            ts = ts._replace(cm=jax.tree_util.tree_map(lambda c: c * 0, ts.cm))
+            log(f"epoch {epoch}: loss {float(loss):.4f} "
+                f"train {M.format_confusion(cm)} "
+                f"({timer.steps_per_sec():.2f} steps/s)")
+            if opt.save:
+                saver.save(epoch,
+                           {"params": ts.params, "model_state": ts.model_state},
+                           metadata={"epoch": epoch})
     jax.block_until_ready(ts.params)
     log("done")
 

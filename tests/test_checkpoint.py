@@ -57,3 +57,33 @@ def test_missing_leaf_raises(tmp_path):
     with pytest.raises(KeyError):
         ckpt.restore_checkpoint(d, {"a": np.zeros(2, np.float32),
                                     "b": np.zeros(2, np.float32)})
+
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    """AsyncCheckpointer: saves land durably, wait() surfaces completion,
+    and a snapshot taken at save() time is immune to later mutation."""
+    d = str(tmp_path)
+    t = _tree()
+    with ckpt.AsyncCheckpointer(d, keep=2) as acp:
+        acp.save(1, t, metadata={"epoch": 1})
+        # mutate AFTER save: the written checkpoint must hold the snapshot
+        t["layer"]["w"] += 100.0
+        acp.save(2, t)
+    assert sorted(ckpt._list_steps(d)) == [1, 2]
+    r1, m1 = ckpt.restore_checkpoint(d, _tree(), step=1)
+    assert m1["epoch"] == 1
+    np.testing.assert_array_equal(r1["layer"]["w"], _tree()["layer"]["w"])
+    r2, _ = ckpt.restore_checkpoint(d, _tree(), step=2)
+    np.testing.assert_array_equal(r2["layer"]["w"],
+                                  _tree()["layer"]["w"] + 100.0)
+
+
+def test_async_checkpointer_error_surfaces(tmp_path):
+    acp = ckpt.AsyncCheckpointer(str(tmp_path / "sub" / "x"))
+    # unwritable parent: make the write fail by pointing at a file path
+    p = tmp_path / "f"
+    p.write_text("x")
+    acp.directory = str(p / "nope")   # a file cannot be a directory
+    acp.save(1, _tree())
+    with pytest.raises(OSError):
+        acp.wait()
