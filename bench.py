@@ -42,7 +42,7 @@ import subprocess
 import sys
 import time
 
-PROTOCOL = "v2-windowed-devget"
+PROTOCOL = "v3-scan-windowed-devget"
 
 
 def _enable_compile_cache():
@@ -114,16 +114,21 @@ def timed_windows(run_window, warmup_window, windows: int):
     return statistics.median(times), times
 
 
-def _build_cifar(batch: int, fused=None, data=None):
+def _build_cifar(batch: int, fused=None, data=None, scan_k: int = 0):
+    """``scan_k=0``: the per-call step (one host dispatch per step).
+    ``scan_k=K``: the scanned step (K chained steps per dispatch,
+    ``train.build_sgd_scan_step``) with K distinct stacked batches."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from jax import random
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from distlearn_tpu.data import synthetic_cifar10
     from distlearn_tpu.models import cifar_convnet
     from distlearn_tpu.parallel.mesh import MeshTree
-    from distlearn_tpu.train import build_sgd_step, init_train_state
+    from distlearn_tpu.train import (build_sgd_scan_step, build_sgd_step,
+                                     init_train_state)
 
     n_dev = len(jax.devices())
     tree = MeshTree(num_nodes=n_dev)
@@ -131,33 +136,51 @@ def _build_cifar(batch: int, fused=None, data=None):
     model = cifar_convnet(
         compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
     ts = init_train_state(model, tree, random.PRNGKey(0), 10)
-    step = build_sgd_step(model, tree, lr=0.1, fused=fused)
-    if data is not None:
-        bx, by = data           # reuse already-placed device batches
+    if scan_k:
+        step = build_sgd_scan_step(model, tree, lr=0.1, fused=fused)
+        xs, ys = [], []
+        for i in range(scan_k):
+            x, y, _ = synthetic_cifar10(batch, seed=i)
+            xs.append(x); ys.append(y)
+        sh = NamedSharding(tree.mesh, P(None, "data"))
+        bx = jax.device_put(np.stack(xs), sh)
+        by = jax.device_put(np.stack(ys), sh)
     else:
-        x, y, _ = synthetic_cifar10(batch, seed=0)
-        sh = NamedSharding(tree.mesh, P("data"))
-        bx, by = jax.device_put(x, sh), jax.device_put(y, sh)
+        step = build_sgd_step(model, tree, lr=0.1, fused=fused)
+        if data is not None:
+            bx, by = data           # reuse already-placed device batches
+        else:
+            x, y, _ = synthetic_cifar10(batch, seed=0)
+            sh = NamedSharding(tree.mesh, P("data"))
+            bx, by = jax.device_put(x, sh), jax.device_put(y, sh)
     return step, ts, bx, by, n_dev
 
 
-def bench_step_fn(step, ts, bx, by, iters: int, windows: int, warmup: int):
-    """Windowed throughput of a ``step(ts,x,y)->(ts,loss)`` fn.  Returns
+def bench_step_fn(step, ts, bx, by, iters: int, windows: int, warmup: int,
+                  steps_per_call: int = 1):
+    """Windowed throughput of a ``step(ts,x,y)->(ts,loss)`` fn.  With
+    ``steps_per_call=K`` (the scanned step) each call advances K training
+    steps; ``iters`` always counts STEPS.  Returns
     (steps_per_sec, window_times, final_loss)."""
+    import numpy as np
     import jax
     state = {"ts": ts, "loss": None}
+    steps_per_call = max(1, steps_per_call)
+    calls = max(1, iters // steps_per_call)
+    steps = calls * steps_per_call
 
-    def run(n):
+    def run(n_calls):
         ts = state["ts"]
-        for _ in range(n):
+        for _ in range(n_calls):
             ts, loss = step(ts, bx, by)
         state["ts"] = ts
-        # Force REAL completion: pull the loss scalar over the wire.
-        state["loss"] = float(jax.device_get(loss))
+        # Force REAL completion: pull the final loss over the wire.
+        state["loss"] = float(np.ravel(jax.device_get(loss))[-1])
 
-    med, times = timed_windows(lambda: run(iters), lambda: run(warmup),
-                               windows)
-    return iters / med, times, state["loss"]
+    med, times = timed_windows(
+        lambda: run(calls), lambda: run(max(1, warmup // steps_per_call)),
+        windows)
+    return steps / med, times, state["loss"]
 
 
 def check_mfu(name: str, flops, steps_per_sec: float, peak):
@@ -267,6 +290,59 @@ def allreduce_proxy_cpu8(size_mb: int):
         return None
 
 
+def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
+    """Host (DCN/TCP) backend microbench: the same payload allreduced through
+    the base-2 tree (the reference's topology, ``T*log2(N)`` —
+    lua/AllReduceEA.md:26-30) and the bandwidth-optimal ring
+    (``2T*(N-1)/N`` per link).  Localhost threads are a protocol proxy — on
+    real multi-host DCN the ring's lower per-link traffic is the win.
+    Returns busbw GB/s for both (NCCL convention)."""
+    import socket
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.comm.ring import LocalhostRing
+    from distlearn_tpu.comm.tree import LocalhostTree, tree_map_spawn
+
+    def _port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    nelem = size_mb * 1024 * 1024 // 4
+    payload = nelem * 4
+
+    def run(make):
+        port = _port()
+
+        def node(rank):
+            h = make(rank, port)
+            x = np.random.RandomState(rank).randn(nelem).astype(np.float32)
+            h.all_reduce(x)         # warmup
+            h.barrier()
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                h.all_reduce(x)
+            dt = _t.perf_counter() - t0
+            h.close()
+            return dt
+        times = tree_map_spawn(node, n, timeout=600)
+        return max(times) / iters     # collective ends when slowest ends
+
+    t_tree = run(lambda r, p: LocalhostTree(r, n, p, base=2))
+    t_ring = run(lambda r, p: LocalhostRing(r, n, p))
+    bus = lambda t: (2 * (n - 1) / n) * payload / t / 1e9  # noqa: E731
+    return {
+        "devices": n, "payload_mb": size_mb,
+        "tree_sec": t_tree, "ring_sec": t_ring,
+        "tree_busbw_gb_s": bus(t_tree), "ring_busbw_gb_s": bus(t_ring),
+        "ring_speedup": t_tree / t_ring,
+    }
+
+
 def bench_resnet50(batch: int, iters: int, windows: int, peak):
     """ResNet-50/ImageNet-shape utilization bench (the model where MFU is
     meaningful — BASELINE.md stretch config)."""
@@ -305,11 +381,29 @@ def bench_resnet50(batch: int, iters: int, windows: int, peak):
 
 
 def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
-                         peak):
+                         peak, flash: bool = False, remat: bool = False):
     """Long-context transformer LM utilization bench: the fused LM train
     step (next-token loss, full backward, SGD) on one chip, bf16 compute.
     On a pod the same step shards over (data, seq, model) axes — see
-    distlearn_tpu.train.lm; this measures the per-chip compute story."""
+    distlearn_tpu.train.lm; this measures the per-chip compute story.
+    ``flash=True`` switches to the Pallas flash-attention kernel (the
+    long-context path: no O(L^2) score buffer).  The env flag is read at
+    trace time, so set it before building the step and restore after."""
+    prev_flash = os.environ.get("DISTLEARN_TPU_FLASH")
+    if flash:
+        os.environ["DISTLEARN_TPU_FLASH"] = "1"
+    try:
+        return _bench_transformer_lm(batch, seq, iters, windows, peak, flash,
+                                     remat)
+    finally:
+        if flash:
+            if prev_flash is None:
+                os.environ.pop("DISTLEARN_TPU_FLASH", None)
+            else:
+                os.environ["DISTLEARN_TPU_FLASH"] = prev_flash
+
+
+def _bench_transformer_lm(batch, seq, iters, windows, peak, flash, remat):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -328,7 +422,7 @@ def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
         raise ValueError(f"BENCH_LM_DIM must be a multiple of 64 "
                          f"(64-dim heads), got {dim}")
     lm = transformer_lm(vocab=32768, dim=dim, depth=depth, heads=dim // 64,
-                        max_len=seq, compute_dtype=jnp.bfloat16)
+                        max_len=seq, compute_dtype=jnp.bfloat16, remat=remat)
     params, _ = lm.init(random.PRNGKey(0))
     step = build_lm_step(lm, mesh, params, lr=1e-2)
     tokens = jax.device_put(
@@ -351,7 +445,7 @@ def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
     mfu = check_mfu("transformer_lm", flops, sps, peak)
     return {
         "batch": batch, "seq_len": seq, "dim": dim, "depth": depth,
-        "steps_per_sec": sps,
+        "flash": flash, "steps_per_sec": sps,
         "tokens_per_sec": sps * batch * seq, "flops_per_step": flops,
         "mfu": mfu, "window_times": times, "final_loss": state["loss"],
     }
@@ -369,29 +463,54 @@ def main():
                      "device_kind": kind, "peak_bf16_flops": peak}
 
     # --- headline: CIFAR-10 convnet fused AllReduceSGD ---------------------
-    step, ts, bx, by, n_dev = _build_cifar(batch)
-    flops = step_flops(step, ts, bx, by)
-    sps, times, loss = bench_step_fn(step, ts, bx, by, iters, windows, warmup)
+    # Measured on the SCANNED step (train.build_sgd_scan_step: K chained
+    # full steps — fwd+bwd+psum+update on K distinct batches — per host
+    # dispatch).  The scan measures the CHIP; the per-call rate (diagnostic
+    # below) additionally measures the host→device dispatch tunnel, whose
+    # latency on this remote-attached chip varies hour to hour.  Per-step
+    # flops come from the per-call program's cost_analysis (XLA reports one
+    # loop iteration's flops for a While program, so the scanned program's
+    # own figure would undercount by K).
+    scan_k = max(1, int(os.environ.get("BENCH_SCAN_K", "20")))
+    step_1, ts_1, bx_1, by_1, n_dev = _build_cifar(batch)
+    flops = step_flops(step_1, ts_1, bx_1, by_1)
+    step_s, ts_s, bxs, bys, _ = _build_cifar(batch, scan_k=scan_k)
+    sps, times, loss = bench_step_fn(step_s, ts_s, bxs, bys, iters, windows,
+                                     warmup, steps_per_call=scan_k)
     mfu = check_mfu("cifar10", flops, sps, peak)
     details["cifar10"] = {
         "batch": batch, "iters": iters, "windows": windows,
+        "steps_per_call": scan_k,
         "steps_per_sec": sps, "images_per_sec": sps * batch,
         "steps_per_sec_per_chip": sps / max(1, n_dev),
         "flops_per_step": flops, "mfu": mfu,
         "window_times": times, "final_loss": loss, "devices": n_dev,
     }
-    print(f"[bench] cifar10 {platform}x{n_dev} batch={batch}: "
-          f"{sps:.1f} steps/s ({sps * batch:.0f} img/s)"
+    print(f"[bench] cifar10 {platform}x{n_dev} batch={batch} "
+          f"(scan x{scan_k}): {sps:.1f} steps/s ({sps * batch:.0f} img/s)"
           + (f", MFU={mfu:.4f}" if mfu is not None else ""),
           file=sys.stderr)
+
+    # Per-call diagnostic: one host round trip per step.  Well below the
+    # scanned rate = the dispatch tunnel, not the chip, is the bottleneck.
+    if os.environ.get("BENCH_SKIP_PERCALL") != "1":
+        sps_1, _, _ = bench_step_fn(step_1, ts_1, bx_1, by_1,
+                                    max(20, iters // 2), 3, warmup=5)
+        details["cifar10_per_dispatch"] = {"steps_per_sec": sps_1,
+                                           "scan_vs_per_call": sps / sps_1}
+        print(f"[bench] per-dispatch: {sps_1:.1f} steps/s "
+              f"(scan {sps / sps_1:.2f}x — dispatch "
+              f"{'bound' if sps / sps_1 > 1.1 else 'fully pipelined'})",
+              file=sys.stderr)
 
     # --- fused vs unfused update delta (Pallas kernels on/off) -------------
     from distlearn_tpu.ops.fused_update import fused_enabled
     if os.environ.get("BENCH_SKIP_UNFUSED") != "1" and fused_enabled(None):
-        step_u, ts_u, _, _, _ = _build_cifar(batch, fused=False,
-                                             data=(bx, by))
-        sps_u, _, _ = bench_step_fn(step_u, ts_u, bx, by,
-                                    max(20, iters // 2), 3, warmup=5)
+        step_u, ts_u, bxu, byu, _ = _build_cifar(batch, fused=False,
+                                                 scan_k=scan_k)
+        sps_u, _, _ = bench_step_fn(step_u, ts_u, bxu, byu,
+                                    max(iters // 2, scan_k), 3, warmup=5,
+                                    steps_per_call=scan_k)
         details["cifar10_unfused_steps_per_sec"] = sps_u
         details["fused_speedup"] = sps / sps_u
         print(f"[bench] unfused: {sps_u:.1f} steps/s "
@@ -408,6 +527,22 @@ def main():
         print(f"[bench] allreduce {ar['payload_mb']}MB x{ar['devices']} "
               f"({ar.get('proxy', 'device mesh')}): "
               f"busbw {ar['busbw_gb_s']:.2f} GB/s", file=sys.stderr)
+
+    # --- host (DCN/TCP) backend: tree vs ring --------------------------------
+    if os.environ.get("BENCH_SKIP_HOST") != "1":
+        try:
+            details["host_allreduce"] = host_allreduce_bench(
+                int(os.environ.get("BENCH_HOST_MB", "16")),
+                int(os.environ.get("BENCH_HOST_NODES", "4")))
+            h = details["host_allreduce"]
+            print(f"[bench] host allreduce {h['payload_mb']}MB x"
+                  f"{h['devices']} (localhost TCP): tree "
+                  f"{h['tree_busbw_gb_s']:.2f} GB/s, ring "
+                  f"{h['ring_busbw_gb_s']:.2f} GB/s "
+                  f"({h['ring_speedup']:.2f}x)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] host allreduce bench failed: {e}",
+                  file=sys.stderr)
 
     # --- ResNet-50 utilization bench ---------------------------------------
     if os.environ.get("BENCH_SKIP_RESNET") != "1" and platform == "tpu":
@@ -443,6 +578,27 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"[bench] transformer_lm bench failed: {e}", file=sys.stderr)
 
+    # --- long-context LM (flash attention, no O(L^2) buffer) ----------------
+    if os.environ.get("BENCH_SKIP_LM_LONG") != "1" and platform == "tpu":
+        lcb = int(os.environ.get("BENCH_LM_LONG_BATCH", "1"))
+        lcs = int(os.environ.get("BENCH_LM_LONG_SEQ", "4096"))
+        lci = int(os.environ.get("BENCH_LM_LONG_ITERS", "15"))
+        try:
+            # flash (no O(L^2) buffer) + remat (recompute activations):
+            # the long-context memory recipe — without them this config
+            # does not fit the chip's HBM at all
+            details["transformer_lm_long"] = bench_transformer_lm(
+                lcb, lcs, lci, 3, peak, flash=True, remat=True)
+            t = details["transformer_lm_long"]
+            print(f"[bench] lm_long (flash) batch={lcb} seq={lcs}: "
+                  f"{t['tokens_per_sec']:.0f} tok/s"
+                  + (f", MFU={t['mfu']:.4f}" if t["mfu"] is not None else ""),
+                  file=sys.stderr)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] lm_long bench failed: {e}", file=sys.stderr)
+
     # --- modeled baseline ---------------------------------------------------
     baseline = (sps if platform == "cpu"
                 else cpu_baseline(batch))
@@ -467,7 +623,8 @@ def main():
         "metric": "cifar10_convnet_allreduce_sgd_steps_per_sec",
         "value": round(sps, 4),
         "unit": (f"steps/s (global batch {batch}, {n_dev} {platform} "
-                 f"chip(s), median of {windows}x{iters}-step windows"
+                 f"chip(s), median of {windows}x{iters}-step windows, "
+                 f"{scan_k} steps/dispatch"
                  + (f", MFU {mfu:.4f}" if mfu is not None else "") + ")"),
         "vs_baseline": round(vs, 4),
     }))
