@@ -8,9 +8,10 @@ from distlearn_tpu.data.dataset import (Dataset, make_dataset, load_npz,
 from distlearn_tpu.data.samplers import (PermutationSampler, LabelUniformSampler,
                                          make_sampler)
 from distlearn_tpu.data.prefetch import prefetch_to_device, batch_iterator
+from distlearn_tpu.data.device_dataset import DeviceDataset
 
 __all__ = [
     "Dataset", "make_dataset", "load_npz", "synthetic_mnist", "synthetic_cifar10", "synthetic_imagenet",
     "PermutationSampler", "LabelUniformSampler", "make_sampler",
-    "prefetch_to_device", "batch_iterator",
+    "prefetch_to_device", "batch_iterator", "DeviceDataset",
 ]

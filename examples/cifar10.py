@@ -33,6 +33,12 @@ def main():
                          "emits one; default: last 10% of --data)"),
         "parity": (False, "print a final JSON accuracy line "
                           "(BASELINE.md accuracy-parity harness)"),
+        "deviceData": (False, "keep the whole dataset resident in device "
+                              "memory and gather batches on-device — the "
+                              "TPU upgrade of torch-dataset's direct-to-GPU "
+                              "cuda batcher (examples/Data.lua:27); "
+                              "per-step host traffic drops to the index "
+                              "vector"),
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -42,8 +48,9 @@ def main():
     from jax import random
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from distlearn_tpu.data import (LabelUniformSampler, PermutationSampler,
-                                    load_npz, make_dataset, synthetic_cifar10)
+    from distlearn_tpu.data import (DeviceDataset, LabelUniformSampler,
+                                    PermutationSampler, load_npz,
+                                    make_dataset, synthetic_cifar10)
     from distlearn_tpu.models import cifar_convnet
     from distlearn_tpu.parallel.mesh import MeshTree
     from distlearn_tpu.train import (build_eval_step, build_sgd_step,
@@ -72,6 +79,24 @@ def main():
     ds = make_dataset(x, y, nc)
     ds_test = make_dataset(xte, yte, nc)
 
+    if opt.deviceData:
+        rep = NamedSharding(tree.mesh, P())
+        out_sh = NamedSharding(tree.mesh, P(tree.axis_name))
+        dds = DeviceDataset(ds.x, ds.y, nc, sharding=rep,
+                            out_sharding=out_sh)
+        dds_test = DeviceDataset(ds_test.x, ds_test.y, nc, sharding=rep,
+                                 out_sharding=out_sh)
+
+    def train_stream(sampler):
+        if opt.deviceData:
+            return dds.batches(sampler, opt.batchSize)
+        return device_stream(tree, ds, sampler, opt.batchSize)
+
+    def test_stream(sampler):
+        if opt.deviceData:
+            return dds_test.batches(sampler, opt.batchSize)
+        return device_stream(tree, ds_test, sampler, opt.batchSize)
+
     model = cifar_convnet(
         compute_dtype=jnp.bfloat16 if opt.bf16 else None)
     ts = init_train_state(model, tree, random.PRNGKey(opt.seed), nc)
@@ -95,7 +120,8 @@ def main():
     cm = jnp.zeros_like(ts.cm)
     for epoch in range(start_epoch, opt.numEpochs + 1):
         sampler = LabelUniformSampler(ds.y, seed=opt.seed + epoch)
-        for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
+        timer.reset_window()   # epoch-boundary eval/ckpt time is not a step
+        for bx, by in train_stream(sampler):
             timer.tick()
             ts, loss = step(ts, bx, by)
         ts = sync(ts)
@@ -107,7 +133,7 @@ def main():
             jnp.zeros((tree.num_nodes, nc, nc), jnp.int32),
             NamedSharding(tree.mesh, P(tree.axis_name)))
         tsampler = PermutationSampler(ds_test.size, seed=0)
-        for bx, by in device_stream(tree, ds_test, tsampler, opt.batchSize):
+        for bx, by in test_stream(tsampler):
             cm, test_loss = ev(ts.params, ts.model_state, cm, bx, by)
         log(f"epoch {epoch}: train {M.format_confusion(train_cm)} | "
             f"test {M.format_confusion(reduce_confusion(cm))} "

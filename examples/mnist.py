@@ -30,6 +30,8 @@ def main():
         "optimizer": ("sgd", "sgd (reference parity, fused Pallas path) | "
                              "momentum | adam | adam-zero1 (optimizer "
                              "state sharded over the nodes)"),
+        "deviceData": (False, "dataset resident in device memory, batches "
+                              "gathered on-device (see cifar10.py)"),
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -37,8 +39,8 @@ def main():
     import numpy as np
     from jax import random
 
-    from distlearn_tpu.data import (PermutationSampler, load_npz, make_dataset,
-                                    synthetic_mnist)
+    from distlearn_tpu.data import (DeviceDataset, PermutationSampler,
+                                    load_npz, make_dataset, synthetic_mnist)
     from distlearn_tpu.models import mnist_cnn
     from distlearn_tpu.parallel.mesh import MeshTree
     from distlearn_tpu.train import (build_sgd_step, build_sync_step,
@@ -56,6 +58,16 @@ def main():
     else:
         x, y, nc = synthetic_mnist(opt.numExamples, seed=opt.seed)
     ds = make_dataset(x, y, nc)
+    if opt.deviceData:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dds = DeviceDataset(
+            ds.x, ds.y, nc, sharding=NamedSharding(tree.mesh, P()),
+            out_sharding=NamedSharding(tree.mesh, P(tree.axis_name)))
+
+    def train_stream(sampler):
+        if opt.deviceData:
+            return dds.batches(sampler, opt.batchSize)
+        return device_stream(tree, ds, sampler, opt.batchSize)
 
     model = mnist_cnn()
     if opt.optimizer == "sgd":      # reference cadence (mnist.lua:112-116)
@@ -90,7 +102,8 @@ def main():
     final_acc = 0.0
     for epoch in range(1, opt.numEpochs + 1):
         sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
-        for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
+        timer.reset_window()   # epoch-boundary sync/report time is not a step
+        for bx, by in train_stream(sampler):
             timer.tick()
             ts, loss = step(ts, bx, by)
             global_step += 1

@@ -120,6 +120,7 @@ def main():
                         f"{float(np.mean(np.asarray(losses))):.4f} "
                         f"{M.format_confusion(cm)}")
         else:
+            timer.reset_window()   # epoch-boundary scatter/ckpt not a step
             for bx, by in device_stream(tree, ds, sampler, opt.batchSize):
                 timer.tick()
                 ets, losses = local_step(ets, bx, by)

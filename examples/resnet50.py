@@ -32,6 +32,9 @@ def main():
         "bf16": (False, "bfloat16 compute (MXU path)"),
         "bucketMB": (16, "gradient bucket size in MiB (0 = one bucket)"),
         "stepsPerEpoch": (0, "cap steps per epoch (0 = full epoch)"),
+        "deviceData": (False, "dataset resident in device memory, batches "
+                              "gathered on-device (see cifar10.py; needs "
+                              "numExamples * imageSize^2 * 12B of HBM)"),
     })
     setup_platform(opt.numNodes, opt.tpu)
 
@@ -39,8 +42,9 @@ def main():
     import jax.numpy as jnp
     from jax import random
 
-    from distlearn_tpu.data import (PermutationSampler, load_npz,
-                                    make_dataset, synthetic_imagenet)
+    from distlearn_tpu.data import (DeviceDataset, PermutationSampler,
+                                    load_npz, make_dataset,
+                                    synthetic_imagenet)
     from distlearn_tpu.models import param_count, resnet50
     from distlearn_tpu.parallel.mesh import MeshTree
     from distlearn_tpu.train import (build_sgd_step, build_sync_step,
@@ -60,6 +64,16 @@ def main():
         x, y, nc = synthetic_imagenet(opt.numExamples, opt.imageSize,
                                       opt.numClasses, seed=opt.seed)
     ds = make_dataset(x, y, nc)
+    if opt.deviceData:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        dds = DeviceDataset(
+            ds.x, ds.y, nc, sharding=NamedSharding(tree.mesh, P()),
+            out_sharding=NamedSharding(tree.mesh, P(tree.axis_name)))
+
+    def train_stream(sampler):
+        if opt.deviceData:
+            return dds.batches(sampler, opt.batchSize)
+        return device_stream(tree, ds, sampler, opt.batchSize)
 
     model = resnet50(num_classes=nc, image_size=opt.imageSize,
                      compute_dtype=jnp.bfloat16 if opt.bf16 else None)
@@ -87,8 +101,8 @@ def main():
     with ckpt.AsyncCheckpointer(opt.save or ".", keep=3) as saver:
         for epoch in range(start_epoch, opt.numEpochs + 1):
             sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
-            for i, (bx, by) in enumerate(
-                    device_stream(tree, ds, sampler, opt.batchSize)):
+            timer.reset_window()   # epoch-boundary eval/ckpt is not a step
+            for i, (bx, by) in enumerate(train_stream(sampler)):
                 timer.tick()
                 ts, loss = step(ts, bx, by)
                 if opt.stepsPerEpoch and i + 1 >= opt.stepsPerEpoch:
