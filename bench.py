@@ -45,6 +45,35 @@ import time
 PROTOCOL = "v3-scan-windowed-devget"
 
 
+def _reserve_port_window(n: int, host: str = "127.0.0.1") -> int:
+    """Base port ``p`` with ``p .. p+n-1`` all bindable a moment ago (the
+    AsyncEA server binds a fan of ports — port, port+1..port+clients,
+    port+clients+1; same pattern as tests/net_util.py)."""
+    import socket
+    from contextlib import closing
+    for _ in range(256):
+        with closing(socket.socket()) as probe:
+            probe.bind((host, 0))
+            base = probe.getsockname()[1]
+        if base + n >= 65535:
+            continue
+        socks = []
+        try:
+            try:
+                for i in range(n):
+                    s = socket.socket()
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind((host, base + i))
+                    socks.append(s)
+            except OSError:
+                continue
+            return base
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"could not reserve a window of {n} free ports")
+
+
 def _enable_compile_cache():
     """Persistent XLA compilation cache: repeated bench runs (driver reruns,
     probe subprocesses) skip the 15-60s single-core compiles."""
@@ -297,7 +326,6 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
     (``2T*(N-1)/N`` per link).  Localhost threads are a protocol proxy — on
     real multi-host DCN the ring's lower per-link traffic is the win.
     Returns busbw GB/s for both (NCCL convention)."""
-    import socket
     import time as _t
 
     import numpy as np
@@ -306,11 +334,7 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
     from distlearn_tpu.comm.tree import LocalhostTree, tree_map_spawn
 
     def _port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
+        return _reserve_port_window(1)
 
     nelem = size_mb * 1024 * 1024 // 4
     payload = nelem * 4
@@ -340,6 +364,70 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
         "tree_sec": t_tree, "ring_sec": t_ring,
         "tree_busbw_gb_s": bus(t_tree), "ring_busbw_gb_s": bus(t_ring),
         "ring_speedup": t_tree / t_ring,
+    }
+
+
+def async_ea_bench(param_mb: int = 8, n_clients: int = 2,
+                   syncs_per_client: int = 10):
+    """AsyncEA parameter-server protocol throughput: how many full
+    Enter?/Center?/delta? sync cycles per second the server sustains, and
+    the payload rate through it (each sync moves the center down and the
+    delta up — 2x the param bytes per cycle).  Localhost TCP through the
+    same framed transport (C++ hot path) the real deployment uses; the
+    reference has no perf visibility on this path at all."""
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer)
+    from distlearn_tpu.utils.logging import set_verbose
+    set_verbose(False)
+
+    # port fan: broadcast + one dedicated per client + test channel
+    port = _reserve_port_window(n_clients + 2)
+
+    nelem = param_mb * 1024 * 1024 // 4
+    params = {"w": np.random.RandomState(0).randn(nelem).astype(np.float32)}
+    total_syncs = n_clients * syncs_per_client
+    out: dict = {}
+
+    def server():
+        srv = AsyncEAServer("127.0.0.1", port, num_nodes=n_clients,
+                            accept_timeout=60.0)
+        srv.init_server({"w": params["w"].copy()})
+        t0 = _t.perf_counter()
+        done = 0
+        p = {"w": params["w"]}
+        while done < total_syncs and srv.live_clients > 0:
+            p = srv.sync_server(p)
+            done += 1
+        out["sec"] = _t.perf_counter() - t0
+        out["syncs"] = done
+        srv.close()
+
+    def client(node):
+        cl = AsyncEAClient("127.0.0.1", port, node=node, tau=1, alpha=0.5)
+        p = cl.init_client({"w": params["w"].copy()})
+        for _ in range(syncs_per_client):
+            p, _ = cl.sync_client(p)
+        cl.close()
+
+    ts = [threading.Thread(target=server, daemon=True)]
+    ts += [threading.Thread(target=client, args=(i + 1,), daemon=True)
+           for i in range(n_clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=300)
+    if "sec" not in out or not out["syncs"]:
+        raise RuntimeError("async EA bench did not complete")
+    sps = out["syncs"] / out["sec"]
+    return {
+        "clients": n_clients, "param_mb": param_mb,
+        "syncs_completed": out["syncs"], "syncs_per_sec": sps,
+        # center down + delta up per sync
+        "payload_gb_s": sps * 2 * nelem * 4 / 1e9,
     }
 
 
@@ -543,6 +631,20 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"[bench] host allreduce bench failed: {e}",
                   file=sys.stderr)
+
+    # --- AsyncEA parameter-server protocol throughput ------------------------
+    if os.environ.get("BENCH_SKIP_ASYNC") != "1":
+        try:
+            details["async_ea"] = async_ea_bench(
+                int(os.environ.get("BENCH_ASYNC_MB", "8")),
+                int(os.environ.get("BENCH_ASYNC_CLIENTS", "2")))
+            a = details["async_ea"]
+            print(f"[bench] asyncEA {a['param_mb']}MB params x"
+                  f"{a['clients']} clients: {a['syncs_per_sec']:.1f} "
+                  f"syncs/s ({a['payload_gb_s']:.2f} GB/s through the "
+                  "server)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] asyncEA bench failed: {e}", file=sys.stderr)
 
     # --- ResNet-50 utilization bench ---------------------------------------
     if os.environ.get("BENCH_SKIP_RESNET") != "1" and platform == "tpu":

@@ -48,6 +48,9 @@ def main():
                               "(client_remote.lua:8,34-39)"),
         "port": (9090, "coordinator port (client_remote.lua:9)"),
         "base": (2, "tree fan-out (client_remote.lua:12)"),
+        "backend": ("tree", "host collective: tree (reference topology, "
+                            "latency-optimal) | ring (bandwidth-optimal — "
+                            "comm/ring.py)"),
         "listenHost": ("", "local bind address for this rank's child "
                            "listener (multi-host: 0.0.0.0)"),
         "advertiseHost": ("", "address other ranks dial to reach this rank"),
@@ -64,6 +67,7 @@ def main():
     import numpy as np
     from jax import random, value_and_grad
 
+    from distlearn_tpu.comm.ring import Ring
     from distlearn_tpu.comm.tree import Tree
     from distlearn_tpu.data import PermutationSampler, load_npz, make_dataset, \
         synthetic_mnist
@@ -75,10 +79,17 @@ def main():
 
     rank = opt.nodeIndex - 1            # reference nodeIndex is 1-based
     log = root_print(rank)
-    tree = Tree(rank, opt.numNodes, opt.host, opt.port, base=opt.base,
-                listen_host=opt.listenHost or None,
-                advertise_host=opt.advertiseHost or None)
-    log(f"tree up: {opt.numNodes} nodes, base {opt.base}, "
+    if opt.backend == "ring":
+        tree = Ring(rank, opt.numNodes, opt.host, opt.port,
+                    listen_host=opt.listenHost or None,
+                    advertise_host=opt.advertiseHost or None)
+    elif opt.backend == "tree":
+        tree = Tree(rank, opt.numNodes, opt.host, opt.port, base=opt.base,
+                    listen_host=opt.listenHost or None,
+                    advertise_host=opt.advertiseHost or None)
+    else:
+        raise SystemExit(f"unknown --backend {opt.backend!r} (tree | ring)")
+    log(f"{opt.backend} up: {opt.numNodes} nodes, "
         f"platform {jax.devices()[0].platform}")
 
     if opt.data:
