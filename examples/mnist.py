@@ -30,6 +30,11 @@ def main():
         "optimizer": ("sgd", "sgd (reference parity, fused Pallas path) | "
                              "momentum | adam | adam-zero1 (optimizer "
                              "state sharded over the nodes)"),
+        "lrSchedule": ("constant", "constant | cosine | warmup-cosine — "
+                                   "optax schedule for the optax "
+                                   "optimizers (--optimizer != sgd; the "
+                                   "sgd path keeps the reference's fixed "
+                                   "lr)"),
         "deviceData": (False, "dataset resident in device memory, batches "
                               "gathered on-device (see cifar10.py)"),
     })
@@ -70,6 +75,14 @@ def main():
         return device_stream(tree, ds, sampler, opt.batchSize)
 
     model = mnist_cnn()
+    _SCHEDULES = ("constant", "cosine", "warmup-cosine")
+    if opt.lrSchedule not in _SCHEDULES:
+        raise SystemExit(f"unknown --lrSchedule {opt.lrSchedule!r} "
+                         f"(choose {', '.join(_SCHEDULES)})")
+    if opt.optimizer == "sgd" and opt.lrSchedule != "constant":
+        raise SystemExit("--lrSchedule needs an optax optimizer "
+                         "(--optimizer momentum|adam|adam-zero1); the sgd "
+                         "path keeps the reference's fixed lr")
     if opt.optimizer == "sgd":      # reference cadence (mnist.lua:112-116)
         ts = init_train_state(model, tree, random.PRNGKey(opt.seed), nc)
         step = build_sgd_step(model, tree, lr=opt.learningRate)
@@ -79,9 +92,20 @@ def main():
         from distlearn_tpu.train import (build_optax_step,
                                          build_zero_optax_step,
                                          init_optax_state, init_zero_state)
-        txs = {"momentum": lambda: optax.sgd(opt.learningRate, momentum=0.9),
-               "adam": lambda: optax.adam(opt.learningRate),
-               "adam-zero1": lambda: optax.adam(opt.learningRate)}
+        total_steps = max(1, opt.numEpochs * (ds.size // opt.batchSize))
+        schedules = {
+            "constant": lambda: opt.learningRate,
+            "cosine": lambda: optax.cosine_decay_schedule(
+                opt.learningRate, decay_steps=total_steps),
+            "warmup-cosine": lambda: optax.warmup_cosine_decay_schedule(
+                0.0, opt.learningRate,
+                warmup_steps=max(1, total_steps // 10),
+                decay_steps=total_steps),
+        }
+        lr = schedules[opt.lrSchedule]()
+        txs = {"momentum": lambda: optax.sgd(lr, momentum=0.9),
+               "adam": lambda: optax.adam(lr),
+               "adam-zero1": lambda: optax.adam(lr)}
         if opt.optimizer not in txs:
             raise SystemExit(f"unknown --optimizer {opt.optimizer!r} "
                              f"(choose sgd, {', '.join(txs)})")
