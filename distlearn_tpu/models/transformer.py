@@ -47,6 +47,67 @@ def _rmsnorm(params, x, eps=1e-6):
     return y * params["scale"].astype(x.dtype)
 
 
+def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
+                seq_axis: str | None = None, tp_axis: str | None = None,
+                ep_axis: str | None = None,
+                moe_capacity_factor: float = 1.25) -> jax.Array:
+    """One transformer block (pre-norm attention + FFN/MoE residuals) on a
+    LOCAL param shard — the single source of truth for the block math,
+    shared by :func:`transformer_lm`'s apply and the pipeline-parallel
+    stage fn (distlearn_tpu.train.lm.build_lm_pp_step).  ``cd`` is the
+    compute dtype; axes as in :func:`transformer_lm`."""
+    h = _rmsnorm(blk["ln1"], x)
+    if tp_axis is not None:   # enter column-parallel region ("f")
+        h = tp_enter(h, tp_axis)
+    q = jnp.einsum("ble,ehd->blhd", h, blk["wq"].astype(cd))
+    k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
+    v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
+    if seq_axis is not None:
+        att = seq_attn(q, k, v, seq_axis, causal=True)
+    else:
+        att = local_attention(q, k, v, causal=True)
+    proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))
+    if tp_axis is not None:   # heads were sharded: reduce ("g")
+        proj = tp_reduce(proj, tp_axis)
+    x = x + proj
+
+    h = _rmsnorm(blk["ln2"], x)
+    if "router" in blk:       # routed MoE FFN (parallel/ep.py)
+        from distlearn_tpu.parallel.ep import moe_ffn, moe_ffn_local
+
+        Bq, Lq, Dq = h.shape
+        flat = h.reshape(Bq * Lq, Dq)
+
+        def expert(p, t):
+            u = jax.nn.gelu(t @ p["we1"].astype(cd)
+                            + p["wb1"].astype(cd))
+            return u @ p["we2"].astype(cd)
+
+        eparams = {k2: blk[k2] for k2 in ("we1", "wb1", "we2")}
+        if ep_axis is None:
+            y = moe_ffn_local(expert, eparams, blk["router"], flat,
+                              moe_capacity_factor)
+        else:                 # one expert per device on ep_axis
+            n_local = blk["we1"].shape[0]
+            if n_local != 1:
+                raise ValueError(
+                    f"stacked expert leaves hold {n_local} shards on this "
+                    "device; expected exactly one per device on ep_axis")
+            local = jax.tree_util.tree_map(
+                lambda a: jnp.squeeze(a, 0), eparams)
+            y = moe_ffn(expert, local, blk["router"], flat,
+                        moe_capacity_factor, axis_name=ep_axis)
+        return x + y.reshape(Bq, Lq, Dq).astype(x.dtype)
+    if tp_axis is not None:
+        h = tp_enter(h, tp_axis)
+    h = h @ blk["w1"].astype(cd) + blk["b1"].astype(cd)
+    h = jax.nn.gelu(h)
+    h = h @ blk["w2"].astype(cd)
+    if tp_axis is not None:   # hidden was sharded: reduce ("g")
+        h = tp_reduce(h, tp_axis)
+    return x + h + blk["b2"].astype(cd)
+
+
 def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
                    dtype=jnp.float32, compute_dtype=None,
@@ -141,58 +202,10 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                                          ).astype(cd)[None]
 
         def block(blk, x):
-            h = _rmsnorm(blk["ln1"], x)
-            if tp_axis is not None:   # enter column-parallel region ("f")
-                h = tp_enter(h, tp_axis)
-            q = jnp.einsum("ble,ehd->blhd", h, blk["wq"].astype(cd))
-            k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
-            v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
-            if seq_axis is not None:
-                att = seq_attn(q, k, v, seq_axis, causal=True)
-            else:
-                att = local_attention(q, k, v, causal=True)
-            proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))
-            if tp_axis is not None:   # heads were sharded: reduce ("g")
-                proj = tp_reduce(proj, tp_axis)
-            x = x + proj
-
-            h = _rmsnorm(blk["ln2"], x)
-            if "router" in blk:       # routed MoE FFN (parallel/ep.py)
-                from distlearn_tpu.parallel.ep import moe_ffn, moe_ffn_local
-
-                Bq, Lq, Dq = h.shape
-                flat = h.reshape(Bq * Lq, Dq)
-
-                def expert(p, t):
-                    u = jax.nn.gelu(t @ p["we1"].astype(cd)
-                                    + p["wb1"].astype(cd))
-                    return u @ p["we2"].astype(cd)
-
-                eparams = {k: blk[k] for k in ("we1", "wb1", "we2")}
-                if ep_axis is None:
-                    y = moe_ffn_local(expert, eparams, blk["router"], flat,
-                                      moe_capacity_factor)
-                else:                 # one expert per device on ep_axis
-                    n_local = blk["we1"].shape[0]
-                    if n_local != 1:
-                        raise ValueError(
-                            f"moe_experts ({moe_experts}) must equal the "
-                            f"ep_axis size (this device holds {n_local} "
-                            "expert shards; expected exactly one per "
-                            "device)")
-                    local = jax.tree_util.tree_map(
-                        lambda a: jnp.squeeze(a, 0), eparams)
-                    y = moe_ffn(expert, local, blk["router"], flat,
-                                moe_capacity_factor, axis_name=ep_axis)
-                return x + y.reshape(Bq, Lq, Dq).astype(x.dtype)
-            if tp_axis is not None:
-                h = tp_enter(h, tp_axis)
-            h = h @ blk["w1"].astype(cd) + blk["b1"].astype(cd)
-            h = jax.nn.gelu(h)
-            h = h @ blk["w2"].astype(cd)
-            if tp_axis is not None:   # hidden was sharded: reduce ("g")
-                h = tp_reduce(h, tp_axis)
-            return x + h + blk["b2"].astype(cd)
+            return block_apply(blk, x, cd, seq_attn=seq_attn,
+                               seq_axis=seq_axis, tp_axis=tp_axis,
+                               ep_axis=ep_axis,
+                               moe_capacity_factor=moe_capacity_factor)
 
         if remat:
             block = jax.checkpoint(block)

@@ -7,7 +7,8 @@ from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          build_sync_step,
                                          build_eval_step, build_ea_steps,
                                          build_ea_cycle, reduce_confusion)
-from distlearn_tpu.train.lm import build_lm_step
+from distlearn_tpu.train.lm import (build_lm_pp_step, build_lm_step,
+                                    stack_blocks, unstack_blocks)
 from distlearn_tpu.train.optim import (OptaxTrainState, ZeroTrainState,
                                        build_optax_step,
                                        build_zero_optax_step,
@@ -17,7 +18,8 @@ __all__ = [
     "TrainState", "EATrainState", "init_train_state", "init_ea_state",
     "build_sgd_step", "build_sgd_scan_step", "build_sync_step",
     "build_eval_step", "build_ea_steps", "build_ea_cycle",
-    "reduce_confusion", "build_lm_step",
+    "reduce_confusion", "build_lm_step", "build_lm_pp_step",
+    "stack_blocks", "unstack_blocks",
     "OptaxTrainState", "build_optax_step", "init_optax_state",
     "ZeroTrainState", "build_zero_optax_step", "init_zero_state",
 ]

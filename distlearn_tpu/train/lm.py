@@ -14,7 +14,9 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distlearn_tpu.models.core import Model
-from distlearn_tpu.models.transformer import lm_loss, param_specs
+from distlearn_tpu.models.transformer import (_rmsnorm, block_apply, lm_loss,
+                                              param_specs)
+from distlearn_tpu.parallel.pp import pipeline_apply
 
 
 def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
@@ -117,3 +119,116 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                            out_specs=(pspecs, P()),
                            check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def stack_blocks(params, depth: int):
+    """Split a :func:`transformer_lm` param pytree into
+    ``(shared, stacked_blocks)``: the embed/pos/out_norm leaves, and the
+    per-block leaves stacked along a new leading ``[depth]`` axis (the
+    pipeline-stage axis — shard it ``P(pipe_axis)``)."""
+    blocks = [params[f"block{i}"] for i in range(depth)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    shared = {k: v for k, v in params.items() if not k.startswith("block")}
+    return shared, stacked
+
+
+def unstack_blocks(shared, stacked, depth: int):
+    """Inverse of :func:`stack_blocks` (back to the apply() layout)."""
+    out = dict(shared)
+    for i in range(depth):
+        out[f"block{i}"] = jax.tree_util.tree_map(lambda a, i=i: a[i],
+                                                  stacked)
+    return out
+
+
+def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,
+                     lr: float, num_microbatches: int,
+                     compute_dtype=None, data_axis: str = "data",
+                     pipe_axis: str = "pipe",
+                     donate: bool = True) -> Callable:
+    """Pipeline-parallel LM train step over a ``(data, pipe)`` mesh:
+    ``step(shared, stacked, tokens) -> (shared, stacked, loss)``.
+
+    One transformer block per pipeline stage (``depth == pipe axis size``);
+    microbatches stream through the stages via
+    :func:`distlearn_tpu.parallel.pp.pipeline_apply`, so the whole GPipe
+    schedule — all ticks, forward and backward — is one XLA program.
+    Embedding/positional/head leaves (``shared``) are replicated over both
+    axes: in the forward they execute on every pipe rank for SPMD
+    uniformity, but gradient only flows on the ranks that use them (rank 0
+    ingests, the last rank computes the head), so their grads are SUMMED
+    over the pipe axis to reassemble and averaged over data.  Block leaves
+    are sharded one-stage-per-device over ``pipe`` (grads reduce over data
+    only).  Composes with data parallelism; TP/SP/MoE stay with
+    :func:`build_lm_step` — the two factorizations cover different model
+    regimes (PP for deep dense stacks whose params exceed one chip).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    depth = jax.tree_util.tree_leaves(stacked_template)[0].shape[0]
+    if depth != n_stages:
+        raise ValueError(
+            f"stacked blocks hold {depth} stages but the {pipe_axis!r} "
+            f"axis has {n_stages} devices (one block per stage)")
+    for need in ("embed", "pos", "out_norm"):
+        if need not in shared_template:
+            raise ValueError(f"shared params missing {need!r} — pass the "
+                             "(shared, stacked) pair from stack_blocks()")
+
+    def step(shared, stacked, tokens):
+        blk_local = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0),
+                                           stacked)
+        S = lax.psum(1, pipe_axis)
+
+        def local_loss(shared, blk_local):
+            cd = compute_dtype or shared["embed"].dtype
+            B, L = tokens.shape
+            x = shared["embed"][tokens].astype(cd)
+            x = x + shared["pos"][:L].astype(cd)[None]
+
+            def stage(bp, h):
+                return block_apply(bp, h, cd)
+
+            h = pipeline_apply(stage, blk_local, x, num_microbatches,
+                               axis_name=pipe_axis)
+            h = _rmsnorm(shared["out_norm"], h)
+            logits = (h @ shared["embed"].T.astype(cd)).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits[:, :-1])
+            targets = tokens[:, 1:]
+            nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
+            # THE PIPE-SHARE SCALING: every pipe rank computes this same
+            # loss from the broadcast pipeline output, so each rank seeds a
+            # full cotangent and the broadcast's psum-transpose multiplies
+            # the upstream gradient by S.  Differentiate the 1/S local
+            # share instead (the lm_loss reduce=False pattern for the seq
+            # axis) — grads come out exact, and the psum'd shares restore
+            # the true loss for reporting.
+            return nll.mean() / S
+
+        local_share, (g_shared, g_blk) = jax.value_and_grad(
+            local_loss, argnums=(0, 1))(shared, blk_local)
+        loss = lax.psum(local_share, pipe_axis)
+        dp = lax.psum(1, data_axis)
+        # shared leaves: partial grads live on the pipe ranks that touched
+        # them — SUM over pipe reassembles; average over data (1/n as in
+        # allreduce_sgd)
+        g_shared = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, (data_axis, pipe_axis))
+            / jnp.asarray(dp, g.dtype), g_shared)
+        g_blk = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, data_axis) / jnp.asarray(dp, g.dtype),
+            g_blk)
+        shared = jax.tree_util.tree_map(
+            lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+            shared, g_shared)
+        blk_local = jax.tree_util.tree_map(
+            lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+            blk_local, g_blk)
+        stacked_new = jax.tree_util.tree_map(lambda a: a[None], blk_local)
+        return shared, stacked_new, lax.pmean(loss, data_axis)
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(pipe_axis), P(data_axis)),
+        out_specs=(P(), P(pipe_axis), P()),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())

@@ -28,6 +28,9 @@ def main():
         "dp": (2, "data-parallel mesh axis size"),
         "sp": (2, "sequence-parallel axis size (ring attention shards)"),
         "tp": (2, "tensor-parallel axis size (Megatron projections)"),
+        "pp": (0, "pipeline-parallel stages (GPipe, one block per stage; "
+                  "requires --sp 1 --tp 1 and --depth == --pp)"),
+        "microbatches": (4, "GPipe microbatches per step (with --pp)"),
         "dim": (128, "model width"),
         "depth": (4, "number of blocks"),
         "vocab": (256, "vocabulary size"),
@@ -47,7 +50,20 @@ def main():
         "tpu": (False, "run on the TPU backend"),
         "seed": (0, "init seed"),
     })
-    n_dev = opt.dp * opt.sp * opt.tp
+    if opt.pp:
+        if opt.sp != 1 or opt.tp != 1:
+            raise SystemExit("--pp composes with data parallelism only: "
+                             "pass --sp 1 --tp 1 (PP and TP/SP cover "
+                             "different model regimes)")
+        if opt.depth != opt.pp:
+            raise SystemExit(f"--pp {opt.pp} needs --depth {opt.pp} "
+                             "(one block per stage)")
+        if opt.accumSteps != 1 or opt.remat or opt.moeExperts:
+            raise SystemExit("--pp does not support --accumSteps/--remat/"
+                             "--moeExperts (GPipe microbatching IS the "
+                             "accumulation/memory lever on this path; MoE "
+                             "needs the expert axis of the non-pp step)")
+    n_dev = opt.dp * opt.sp * opt.tp * max(1, opt.pp)
     setup_platform(n_dev, opt.tpu)
 
     import jax
@@ -60,7 +76,8 @@ def main():
 
     from distlearn_tpu.models.transformer import (lm_loss, param_specs,
                                                   transformer_lm)
-    from distlearn_tpu.train.lm import build_lm_step
+    from distlearn_tpu.train.lm import (build_lm_pp_step, build_lm_step,
+                                        stack_blocks)
     from distlearn_tpu.utils.logging import root_print
     from distlearn_tpu.utils.profiling import StepTimer, trace
 
@@ -70,28 +87,49 @@ def main():
                          f"{opt.dp} (one expert per data-parallel device)")
     devs = jax.devices()
     if len(devs) < n_dev:
-        raise SystemExit(f"need {n_dev} devices (dp*sp*tp), "
+        raise SystemExit(f"need {n_dev} devices (dp*sp*tp*pp), "
                          f"have {len(devs)}")
-    mesh = Mesh(np.array(devs[:n_dev]).reshape(opt.dp, opt.sp, opt.tp),
-                ("data", "seq", "model"))
-    log(f"mesh dp={opt.dp} sp={opt.sp} tp={opt.tp} on "
-        f"{devs[0].platform}; seq_impl={opt.seqImpl}"
-        + (f"; {opt.moeExperts} experts" if opt.moeExperts else ""))
-
+    cdtype = jnp.bfloat16 if opt.bf16 else None
     lm = transformer_lm(
         vocab=opt.vocab, dim=opt.dim, depth=opt.depth,
         heads=max(4, opt.dim // 64), max_len=opt.seqLen,
-        compute_dtype=jnp.bfloat16 if opt.bf16 else None,
+        compute_dtype=cdtype,
         seq_impl=opt.seqImpl, remat=opt.remat,
         moe_experts=opt.moeExperts)
     params, _ = lm.init(random.PRNGKey(opt.seed))
-    ep_axis = "data" if opt.moeExperts else None
-    step = build_lm_step(lm, mesh, params, lr=opt.learningRate,
-                         ep_axis=ep_axis, accum_steps=opt.accumSteps)
-    params = jax.device_put(
-        params, jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s),
-            param_specs(params, tp_axis="model", ep_axis=ep_axis)))
+    if opt.pp:
+        mesh = Mesh(np.array(devs[:n_dev]).reshape(opt.dp, opt.pp),
+                    ("data", "pipe"))
+        log(f"mesh dp={opt.dp} pipe={opt.pp} on {devs[0].platform}; "
+            f"{opt.microbatches} microbatches")
+        shared, stacked = stack_blocks(params, opt.depth)
+        shared = jax.device_put(shared, NamedSharding(mesh, P()))
+        stacked = jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
+        pp_step = build_lm_pp_step(mesh, shared, stacked,
+                                   lr=opt.learningRate,
+                                   num_microbatches=opt.microbatches,
+                                   compute_dtype=cdtype)
+        state = {"shared": shared, "stacked": stacked}
+
+        def step(st, tokens):
+            sh, stk, loss = pp_step(st["shared"], st["stacked"], tokens)
+            return {"shared": sh, "stacked": stk}, loss
+        params = state
+        tok_spec = P("data")
+    else:
+        mesh = Mesh(np.array(devs[:n_dev]).reshape(opt.dp, opt.sp, opt.tp),
+                    ("data", "seq", "model"))
+        log(f"mesh dp={opt.dp} sp={opt.sp} tp={opt.tp} on "
+            f"{devs[0].platform}; seq_impl={opt.seqImpl}"
+            + (f"; {opt.moeExperts} experts" if opt.moeExperts else ""))
+        ep_axis = "data" if opt.moeExperts else None
+        step = build_lm_step(lm, mesh, params, lr=opt.learningRate,
+                             ep_axis=ep_axis, accum_steps=opt.accumSteps)
+        params = jax.device_put(
+            params, jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                param_specs(params, tp_axis="model", ep_axis=ep_axis)))
+        tok_spec = P("data", "seq")
 
     # Synthetic corpus: order-2 Markov tokens — learnable next-token
     # structure without any dataset download (zero-egress env).
@@ -104,7 +142,7 @@ def main():
         for b in range(opt.batchSize):
             toks[b, t] = rng.choice(opt.vocab, p=trans[toks[b, t - 1]])
     tokens = jax.device_put(jnp.asarray(toks),
-                            NamedSharding(mesh, P("data", "seq")))
+                            NamedSharding(mesh, tok_spec))
 
     timer = StepTimer()
     do_profile = bool(opt.profile) and opt.steps >= 6

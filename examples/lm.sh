@@ -1,6 +1,8 @@
 #!/bin/bash
 # Long-context LM on an 8-device virtual mesh: dp2 x sp2(ring attn) x tp2,
-# then a 4-expert MoE variant with experts sharded over the data axis.
+# a 4-expert MoE variant (experts sharded over the data axis), and a
+# dp2 x pipe4 GPipe pipeline (one block per stage).
 cd "$(dirname "$0")"
 python lm.py --dp 2 --sp 2 --tp 2 "$@"
 python lm.py --dp 4 --sp 2 --tp 1 --moeExperts 4 "$@"
+python lm.py --dp 2 --sp 1 --tp 1 --pp 4 --depth 4 "$@"
