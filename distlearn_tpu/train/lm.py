@@ -6,7 +6,7 @@ projections) in ONE jitted shard_map program.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -232,3 +232,75 @@ def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,
         out_specs=(P(), P(pipe_axis), P()),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+class LMEAState(NamedTuple):
+    """Per-node elastic-averaging state for LM training: every leaf has a
+    leading ``[num_nodes]`` axis sharded over the data mesh axis (replicas
+    deliberately diverge between rounds — lua/AllReduceEA.lua semantics on
+    the transformer family the reference never had)."""
+    params: Any
+    center: Any
+    vel: Any
+
+
+def init_lm_ea_state(model: Model, tree, key) -> LMEAState:
+    """Identical init on every node, center := params, zero momentum
+    (mirrors distlearn_tpu.train.trainer.init_ea_state for classifiers)."""
+    params, _ = model.init(key)
+    n = tree.num_nodes
+    stack = lambda t: tree.put_per_node(jax.tree_util.tree_map(  # noqa: E731
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t))
+    return LMEAState(params=stack(params), center=stack(params),
+                     vel=stack(jax.tree_util.tree_map(jnp.zeros_like,
+                                                      params)))
+
+
+def build_lm_ea_steps(model: Model, tree, lr: float, alpha: float,
+                      momentum: float = 0.0, donate: bool = True,
+                      fused: bool | None = None,
+                      max_bucket_bytes: int | None = None):
+    """EASGD for the transformer LM over a data mesh axis: returns
+    ``(local_step, ea_round)`` with the same contract as
+    :func:`distlearn_tpu.train.trainer.build_ea_steps` — τ−1 of every τ
+    steps run with ZERO collectives (the host owns the τ cadence), then
+    one fused elastic round couples the replicas through the center
+    (lua/AllReduceEA.lua:25-47 recast; ``momentum`` adds the paper's
+    EAMSGD local rule).
+
+    ``local_step(state, tokens) -> (state, losses[num_nodes])`` — tokens
+    ``[global_B, L]`` sharded over the data axis; each node trains its own
+    replica on its shard.  ``ea_round(state) -> state``.
+    """
+    from distlearn_tpu.parallel.mesh import expand_node, squeeze_node
+    from distlearn_tpu.train.trainer import (apply_elastic_round,
+                                             local_update)
+    axis = tree.axis_name
+
+    def local_step(st: LMEAState, tokens):
+        p = squeeze_node(st.params)
+        loss, grads = jax.value_and_grad(
+            lambda q: lm_loss(model, q, tokens, seq_axis=None,
+                              tp_axis=None))(p)
+        p, v = local_update(p, grads, squeeze_node(st.vel), lr, momentum)
+        vel = expand_node(v) if momentum else st.vel
+        return (LMEAState(expand_node(p), st.center, vel),
+                loss[None] if loss.ndim == 0 else loss)
+
+    def ea_round(st: LMEAState):
+        p, c = apply_elastic_round(squeeze_node(st.params),
+                                   squeeze_node(st.center), alpha, axis,
+                                   fused, max_bucket_bytes)
+        return LMEAState(expand_node(p), expand_node(c), st.vel)
+
+    spec = LMEAState(params=P(axis), center=P(axis), vel=P(axis))
+    local = jax.jit(
+        jax.shard_map(local_step, mesh=tree.mesh,
+                      in_specs=(spec, P(axis)),
+                      out_specs=(spec, P(axis)), check_vma=False),
+        donate_argnums=(0,) if donate else ())
+    rnd = jax.jit(
+        jax.shard_map(ea_round, mesh=tree.mesh, in_specs=(spec,),
+                      out_specs=spec, check_vma=False),
+        donate_argnums=(0,) if donate else ())
+    return local, rnd

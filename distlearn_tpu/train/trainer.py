@@ -65,6 +65,38 @@ def _sgd_update(params: PyTree, grads: PyTree, lr) -> PyTree:
         params, grads)
 
 
+def local_update(params: PyTree, grads: PyTree, vel: PyTree, lr: float,
+                 momentum: float) -> tuple[PyTree, PyTree]:
+    """The EA-family local optimizer, shared by the classifier and LM
+    paths: plain SGD (``momentum=0``, velocity untouched) or heavy-ball
+    EAMSGD (arXiv:1412.6651 §3: ``v = μ·v + g; p -= lr·v``)."""
+    if not momentum:
+        return _sgd_update(params, grads, lr), vel
+    vel = jax.tree_util.tree_map(
+        lambda v, g: jnp.asarray(momentum, v.dtype) * v + g.astype(v.dtype),
+        vel, grads)
+    params = jax.tree_util.tree_map(
+        lambda p, v: p - jnp.asarray(lr, p.dtype) * v.astype(p.dtype),
+        params, vel)
+    return params, vel
+
+
+def apply_elastic_round(params: PyTree, center: PyTree, alpha: float,
+                        axis: str, fused: bool | None = None,
+                        max_bucket_bytes: int | None = None
+                        ) -> tuple[PyTree, PyTree]:
+    """One fused elastic round on LOCAL (per-node) pytrees, shared by the
+    classifier and LM paths: Pallas packed buckets when enabled (one psum
+    per bucket), per-leaf XLA round otherwise."""
+    if fused_update.fused_enabled(fused):
+        return fused_update.elastic_round_buckets(params, center, alpha,
+                                                  axis, max_bucket_bytes)
+    st = allreduce_ea.EAState(center=center, step=jnp.zeros((), jnp.int32))
+    params, st = allreduce_ea.elastic_round(params, st, alpha,
+                                            axis_name=axis)
+    return params, st.center
+
+
 def init_common(model: Model, tree: MeshTree, key: jax.Array,
                 num_classes: int):
     """Shared data-parallel state init: identical params on every node, a
@@ -366,34 +398,17 @@ def _make_ea_bodies(model: Model, tree: MeshTree, lr: float, alpha: float,
 
         (loss, (log_probs, mstate)), grads = \
             jax.value_and_grad(_loss, has_aux=True)(params)
-        vel = ts.vel
-        if momentum:
-            # EAMSGD local rule (arXiv:1412.6651 §3): heavy-ball velocity.
-            v = jax.tree_util.tree_map(
-                lambda v, g: jnp.asarray(momentum, v.dtype) * v
-                + g.astype(v.dtype), _sq(ts.vel), grads)
-            params = jax.tree_util.tree_map(
-                lambda p, v: p - jnp.asarray(lr, p.dtype) * v.astype(p.dtype),
-                params, v)
-            vel = _ex(v)
-        else:
-            params = _sgd_update(params, grads, lr)
+        params, v = local_update(params, grads, _sq(ts.vel), lr, momentum)
+        vel = _ex(v) if momentum else ts.vel
         cm = metrics_lib.update_confusion(cm, log_probs, y)
         new_ts = EATrainState(_ex(params), _ex(mstate), ts.center, vel,
                               _ex(cm), _ex(rng))
         return new_ts, loss[None] if loss.ndim == 0 else loss
 
     def ea_round(ts: EATrainState):
-        params, center = _sq(ts.params), _sq(ts.center)
-        if use_fused:
-            params, center = fused_update.elastic_round_buckets(
-                params, center, alpha, axis, max_bucket_bytes)
-        else:
-            st = allreduce_ea.EAState(center=center,
-                                      step=jnp.zeros((), jnp.int32))
-            params, st = allreduce_ea.elastic_round(params, st, alpha,
-                                                    axis_name=axis)
-            center = st.center
+        params, center = apply_elastic_round(
+            _sq(ts.params), _sq(ts.center), alpha, axis, use_fused,
+            max_bucket_bytes)
         return EATrainState(_ex(params), ts.model_state, _ex(center),
                             ts.vel, ts.cm, ts.rng)
 

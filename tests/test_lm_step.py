@@ -159,3 +159,46 @@ def test_lm_pp_step_matches_sequential():
         assert str(pa) == str(pb)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6, err_msg=str(pa))
+
+
+def test_lm_ea_diverge_contract_converge():
+    """EASGD on the transformer LM (the reference's core algorithm on the
+    model family it never had): replicas diverge over collective-free
+    local steps, one elastic round contracts them, training converges;
+    center replicas stay bitwise identical."""
+    from distlearn_tpu.parallel.mesh import MeshTree
+    from distlearn_tpu.train import build_lm_ea_steps, init_lm_ea_state
+
+    tree = MeshTree(num_nodes=4)
+    vocab, L, B = 32, 16, 8
+    lm = transformer_lm(vocab=vocab, dim=32, depth=2, heads=2, max_len=L)
+    st = init_lm_ea_state(lm, tree, jax.random.PRNGKey(0))
+    local, rnd = build_lm_ea_steps(lm, tree, lr=0.1, alpha=0.25,
+                                   momentum=0.9, donate=False)
+    rng = np.random.RandomState(0)
+    sh = NamedSharding(tree.mesh, P("data"))
+
+    def spread(s):
+        leaf = jax.tree_util.tree_leaves(s.params)[0]
+        arr = np.asarray(jax.device_get(leaf))
+        return float(np.abs(arr - arr[0]).max())
+
+    assert spread(st) == 0.0
+    first = last = None
+    for k in range(30):
+        toks = jax.device_put(
+            rng.randint(0, vocab, (B, L)).astype(np.int32), sh)
+        st, losses = local(st, toks)
+        m = float(np.mean(np.asarray(losses)))
+        first = m if first is None else first
+        last = m
+        if k == 14:
+            d_before = spread(st)
+            assert d_before > 0      # replicas saw different shards
+            st = rnd(st)
+            assert spread(st) < d_before   # elastic round contracts
+    assert last < first
+    c = jax.tree_util.tree_leaves(st.center)[0]
+    arr = np.asarray(jax.device_get(c))
+    for i in range(1, arr.shape[0]):
+        np.testing.assert_array_equal(arr[0], arr[i])
