@@ -82,6 +82,14 @@ class _Sender:
     def put_msg(self, msg):
         self._q.put(("J", msg))
 
+    def check(self):
+        """Raise a send error already known locally WITHOUT waiting for the
+        queue to drain — called before blocking on the predecessor recv so a
+        dead successor surfaces immediately instead of wedging the
+        collective until (op_)timeout."""
+        if self._err:
+            raise self._err[0]
+
     def flush(self):
         self._q.join()
         if self._err:
@@ -100,6 +108,11 @@ class Ring:
     ``listen_host``/``advertise_host``; ``op_timeout`` arms per-link failure
     detection (a dead neighbor raises :class:`TimeoutError` instead of
     wedging — the reference wedges, SURVEY.md §5).
+
+    A send failure the sender worker has already observed is raised before
+    each blocking predecessor recv (``_Sender.check``), but a successor
+    that dies mid-recv can still only be detected by the recv deadline —
+    set ``op_timeout`` in production deployments.
     """
 
     def __init__(self, rank: int, num_nodes: int, host: str, port: int,
@@ -241,6 +254,7 @@ class Ring:
         total = meta.copy()
         for _ in range(self.num_nodes - 1):
             self._sender.put_msg({"m": tok.tolist()})
+            self._sender.check()
             tok = np.asarray(self._pred.recv_msg()["m"], np.int64)
             total += tok
             self._sender.flush()
@@ -257,6 +271,7 @@ class Ring:
         # s+2 ranks' contributions; after n-1 steps chunk (rank+1) is final.
         for s in range(n - 1):
             self._sender.put_tensor(chunk(rank - s))
+            self._sender.check()
             part = self._pred.recv_tensor()
             c = chunk(rank - s - 1)
             native.reduce_inplace(c, part.astype(c.dtype, copy=False), op)
@@ -264,6 +279,7 @@ class Ring:
         # allgather: circulate each finalized chunk n-1 hops.
         for s in range(n - 1):
             self._sender.put_tensor(chunk(rank + 1 - s))
+            self._sender.check()
             part = self._pred.recv_tensor(out=chunk(rank - s))
             self._sender.flush()
 

@@ -50,12 +50,18 @@ def _rmsnorm(params, x, eps=1e-6):
 def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
                 seq_axis: str | None = None, tp_axis: str | None = None,
                 ep_axis: str | None = None,
-                moe_capacity_factor: float = 1.25) -> jax.Array:
+                moe_capacity_factor: float = 1.25, moe_top_k: int = 1,
+                return_moe_aux: bool = False):
     """One transformer block (pre-norm attention + FFN/MoE residuals) on a
     LOCAL param shard — the single source of truth for the block math,
     shared by :func:`transformer_lm`'s apply and the pipeline-parallel
     stage fn (distlearn_tpu.train.lm.build_lm_pp_step).  ``cd`` is the
-    compute dtype; axes as in :func:`transformer_lm`."""
+    compute dtype; axes as in :func:`transformer_lm`.
+
+    ``return_moe_aux=True`` (MoE blocks only) returns ``(x, aux)`` with
+    the routing-health dict from :func:`distlearn_tpu.parallel.ep
+    .route_topk` (balance loss + dropped fraction) — an explicit output,
+    not a side channel, so it survives ``jax.checkpoint``."""
     h = _rmsnorm(blk["ln1"], x)
     if tp_axis is not None:   # enter column-parallel region ("f")
         h = tp_enter(h, tp_axis)
@@ -86,7 +92,8 @@ def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
         eparams = {k2: blk[k2] for k2 in ("we1", "wb1", "we2")}
         if ep_axis is None:
             y = moe_ffn_local(expert, eparams, blk["router"], flat,
-                              moe_capacity_factor)
+                              moe_capacity_factor, top_k=moe_top_k,
+                              return_aux=return_moe_aux)
         else:                 # one expert per device on ep_axis
             n_local = blk["we1"].shape[0]
             if n_local != 1:
@@ -96,8 +103,14 @@ def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
             local = jax.tree_util.tree_map(
                 lambda a: jnp.squeeze(a, 0), eparams)
             y = moe_ffn(expert, local, blk["router"], flat,
-                        moe_capacity_factor, axis_name=ep_axis)
+                        moe_capacity_factor, axis_name=ep_axis,
+                        top_k=moe_top_k, return_aux=return_moe_aux)
+        if return_moe_aux:
+            y, aux = y
+            return x + y.reshape(Bq, Lq, Dq).astype(x.dtype), aux
         return x + y.reshape(Bq, Lq, Dq).astype(x.dtype)
+    if return_moe_aux:
+        raise ValueError("return_moe_aux=True on a dense block (no router)")
     if tp_axis is not None:
         h = tp_enter(h, tp_axis)
     h = h @ blk["w1"].astype(cd) + blk["b1"].astype(cd)
@@ -113,7 +126,8 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    dtype=jnp.float32, compute_dtype=None,
                    seq_impl: str = "ring", remat: bool = False,
                    moe_experts: int = 0, moe_every: int = 2,
-                   moe_capacity_factor: float = 1.25) -> Model:
+                   moe_capacity_factor: float = 1.25,
+                   moe_top_k: int = 1) -> Model:
     """Returns a :class:`Model` whose ``apply(params, state, tokens, ...)``
     maps int tokens [B, L_local] -> next-token logits [B, L_local, vocab].
 
@@ -128,12 +142,20 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     trade for long-context/deep configs.
 
     ``moe_experts=E`` makes every ``moe_every``-th block's FFN a routed
-    top-1 mixture of ``E`` experts (parallel/ep.py).  Pass ``ep_axis`` to
-    ``apply`` to shard the experts one-per-device over that mesh axis
-    (requires ``E == axis size``; the data axis is the usual choice —
-    EP group == DP group); with ``ep_axis=None`` all experts run locally.
-    MoE blocks bypass tensor parallelism (their parallelism IS the expert
-    axis); the router stays replicated so routing is identical everywhere.
+    top-``moe_top_k`` mixture of ``E`` experts (parallel/ep.py; k=1 is
+    Switch, k=2 GShard).  Pass ``ep_axis`` to ``apply`` to shard the
+    experts one-per-device over that mesh axis (requires ``E == axis
+    size``; the data axis is the usual choice — EP group == DP group);
+    with ``ep_axis=None`` all experts run locally.  MoE blocks bypass
+    tensor parallelism (their parallelism IS the expert axis); the router
+    stays replicated so routing is identical everywhere.
+
+    MoE models return routing-health metrics through the state output:
+    ``apply`` yields ``(logits, {"moe_balance_loss", "moe_dropped_frac"})``
+    — the mean Switch balance loss and dropped-assignment fraction over
+    the MoE blocks.  :func:`lm_loss` folds the balance term into the
+    training loss with ``moe_balance_weight`` (the Switch §2.2 auxiliary:
+    without it, top-1 routing collapses onto a few experts).
     """
     if seq_impl not in ("ring", "alltoall"):
         raise ValueError(f"seq_impl must be 'ring' or 'alltoall', "
@@ -146,6 +168,9 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
             f"moe_every={moe_every} > depth={depth}: no block would be MoE "
             f"— the requested {moe_experts}-expert model would silently "
             "train dense")
+    if moe_experts > 0 and not 1 <= moe_top_k <= moe_experts:
+        raise ValueError(f"moe_top_k={moe_top_k} must be in "
+                         f"[1, moe_experts={moe_experts}]")
     seq_attn = ring_attention if seq_impl == "ring" else alltoall_attention
 
     def _is_moe(i: int) -> bool:
@@ -201,16 +226,28 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
         x = x + lax.dynamic_slice_in_dim(params["pos"], offset, L
                                          ).astype(cd)[None]
 
-        def block(blk, x):
-            return block_apply(blk, x, cd, seq_attn=seq_attn,
-                               seq_axis=seq_axis, tp_axis=tp_axis,
-                               ep_axis=ep_axis,
-                               moe_capacity_factor=moe_capacity_factor)
+        def make_block(is_moe):
+            def block(blk, x):
+                return block_apply(blk, x, cd, seq_attn=seq_attn,
+                                   seq_axis=seq_axis, tp_axis=tp_axis,
+                                   ep_axis=ep_axis,
+                                   moe_capacity_factor=moe_capacity_factor,
+                                   moe_top_k=moe_top_k,
+                                   return_moe_aux=is_moe)
+            return jax.checkpoint(block) if remat else block
 
-        if remat:
-            block = jax.checkpoint(block)
+        balance = dropped = n_moe = 0
         for i in range(depth):
-            x = block(params[f"block{i}"], x)
+            if _is_moe(i):
+                x, aux = make_block(True)(params[f"block{i}"], x)
+                balance = balance + aux["balance_loss"]
+                dropped = dropped + aux["dropped_frac"]
+                n_moe += 1
+            else:
+                x = make_block(False)(params[f"block{i}"], x)
+        if n_moe:
+            state = dict(state, moe_balance_loss=balance / n_moe,
+                         moe_dropped_frac=dropped / n_moe)
 
         x = _rmsnorm(params["out_norm"], x)
         logits = x @ params["embed"].T.astype(cd)
@@ -248,7 +285,8 @@ def param_specs(params: PyTree, tp_axis: str | None,
 
 
 def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
-            ep_axis=None, reduce: bool = True):
+            ep_axis=None, reduce: bool = True,
+            moe_balance_weight: float = 0.0):
     """Next-token cross-entropy.  With a sequence axis, the final position's
     target lives on the next shard — the shift rides a ppermute so the loss
     is exact across shard boundaries.
@@ -258,15 +296,23 @@ def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
     the form to differentiate inside shard_map: ``psum`` transposes to
     ``psum`` there, so differentiating the psum'd global loss would scale
     gradients by the seq-axis size; differentiate the local share and psum
-    the resulting partial gradients instead (distlearn_tpu.train.lm)."""
-    logits, _ = model.apply(params, {}, tokens, train=True,
-                            seq_axis=seq_axis, tp_axis=tp_axis,
-                            ep_axis=ep_axis)
+    the resulting partial gradients instead (distlearn_tpu.train.lm).
+
+    ``moe_balance_weight`` adds that multiple of the model's Switch
+    load-balancing loss (state output ``moe_balance_loss``) — required for
+    stable MoE training; ignored for dense models."""
+    logits, st = model.apply(params, {}, tokens, train=True,
+                             seq_axis=seq_axis, tp_axis=tp_axis,
+                             ep_axis=ep_axis)
+    bal = (moe_balance_weight * st["moe_balance_loss"]
+           if moe_balance_weight and isinstance(st, dict)
+           and "moe_balance_loss" in st else None)
     if seq_axis is None:
         targets = tokens[:, 1:]
         lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
         nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
-        return nll.mean()
+        loss = nll.mean()
+        return loss + bal if bal is not None else loss
     # first token of the NEXT shard (ring shift by -1)
     n = lax.psum(1, seq_axis)
     perm = [(j, (j - 1) % n) for j in range(n)]
@@ -282,4 +328,8 @@ def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
     w = (pos < n * L - 1).astype(jnp.float32)
     count = lax.psum(jnp.sum(w) * tokens.shape[0], seq_axis)
     local = jnp.sum(nll * w[None, :]) / jnp.maximum(count, 1.0)
+    if bal is not None:
+        # each shard routes its own tokens: 1/n of the balance term per
+        # shard makes the psum'd total the cross-shard mean
+        local = local + bal / n
     return lax.psum(local, seq_axis) if reduce else local

@@ -8,7 +8,7 @@ from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
 from distlearn_tpu.parallel.sequence import (ring_attention, local_attention,
                                              alltoall_attention)
 from distlearn_tpu.parallel.pp import pipeline_apply
-from distlearn_tpu.parallel.ep import moe_ffn, route_top1
+from distlearn_tpu.parallel.ep import moe_ffn, route_top1, route_topk
 from distlearn_tpu.parallel.host_algorithms import (TreeAllReduceSGD,
                                                     TreeAllReduceEA)
 
@@ -28,6 +28,7 @@ __all__ = [
     "pipeline_apply",
     "moe_ffn",
     "route_top1",
+    "route_topk",
     "TreeAllReduceSGD",
     "TreeAllReduceEA",
 ]

@@ -30,44 +30,97 @@ from jax import lax
 PyTree = Any
 
 
-def route_top1(router_logits: jax.Array, capacity: int
-               ) -> tuple[jax.Array, jax.Array]:
-    """Top-1 routing with capacity.
+def route_topk(router_logits: jax.Array, capacity: int, k: int = 1
+               ) -> tuple[jax.Array, jax.Array, dict]:
+    """Top-k routing with capacity (k=1: Switch; k=2: GShard).
 
     Args:
       router_logits: ``[N, E]`` raw router scores for local tokens.
       capacity: per-expert bucket size ``C``.
+      k: experts per token.  Combine weights are the chosen gates
+        renormalized over the k picks (GShard); with k=1 this is the raw
+        top-1 gate (Switch).  Bucket slots are claimed in rank order —
+        every token's 1st choice before any token's 2nd — so congestion
+        drops low-rank assignments first.
+
+    Returns ``(dispatch, combine, aux)``: dispatch ``[N, E, C]`` bool —
+    token n occupies slot c of expert e; combine ``[N, E, C]`` float32 —
+    gate weight at the same coordinates (zero for dropped assignments);
+    aux — routing health terms:
+
+    * ``balance_loss``: the Switch load-balancing loss ``E · Σ_e f_e·P_e``
+      (arXiv:2101.03961 eq. 4-6): ``f_e`` = fraction of tokens whose TOP
+      choice is expert e, ``P_e`` = mean router probability on e.  Equals
+      1.0 at perfect balance; grows as the router collapses.  Both factors
+      see the pre-capacity assignment, so the gradient pushes the router
+      itself toward balance (differentiable through ``P_e``).
+    * ``dropped_frac``: fraction of the ``N*k`` assignments dropped by
+      capacity (combine weight zero — tokens fall back to the residual).
+    """
+    N, E = router_logits.shape
+    if not 1 <= k <= E:
+        raise ValueError(f"top-k routing needs 1 <= k <= num_experts, "
+                         f"got k={k} with {E} experts")
+    gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    topv, topi = lax.top_k(gates, k)                        # [N, k]
+    if k == 1:
+        weights = topv          # Switch: the RAW top-1 gate scales the
+        # output, so router gradients flow through the kept path
+    else:
+        # GShard: renormalize the chosen gates over the k picks
+        weights = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    dispatch3 = jnp.zeros((N, E, capacity), jnp.bool_)
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    counts = jnp.zeros((E,), jnp.int32)     # slots claimed by higher ranks
+    for j in range(k):
+        onehot = jax.nn.one_hot(topi[:, j], E, dtype=jnp.int32)  # [N, E]
+        # position within the expert bucket, after rank<j claims.  If a
+        # higher rank overflowed the bucket, counts pushes pos past
+        # capacity — full buckets drop lower ranks either way.
+        pos = (jnp.cumsum(onehot, axis=0) + counts[None, :]) * onehot - 1
+        disp = (onehot > 0) & (pos < capacity)              # [N, E] kept?
+        slot = jax.nn.one_hot(jnp.where(disp, pos, -1), capacity,
+                              dtype=jnp.bool_)              # [N, E, C]
+        d3 = slot & disp[..., None]
+        dispatch3 = dispatch3 | d3
+        combine = combine + d3.astype(jnp.float32) \
+            * weights[:, j][:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
+        if j == 0:
+            frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    frac_probs = jnp.mean(gates, axis=0)                    # P_e
+    aux = {
+        "balance_loss": E * jnp.sum(frac_tokens * frac_probs),
+        "dropped_frac": 1.0 - jnp.sum(dispatch3.astype(jnp.float32))
+        / (N * k),
+    }
+    return dispatch3, combine, aux
+
+
+def route_top1(router_logits: jax.Array, capacity: int
+               ) -> tuple[jax.Array, jax.Array]:
+    """Top-1 routing with capacity (``route_topk`` with k=1, aux dropped).
 
     Returns ``(dispatch, combine)``: dispatch ``[N, E, C]`` bool — token n
     goes to slot c of expert e; combine ``[N, E, C]`` float32 — softmax
     gate weight at the same coordinates (zero for dropped tokens).
     """
-    N, E = router_logits.shape
-    gates = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(gates, axis=-1)                     # [N]
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)     # [N, E]
-    # position of each token within its expert's bucket (0-based)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1           # [N, E]
-    dispatch = (onehot > 0) & (pos < capacity)              # [N, E] kept?
-    slot = jax.nn.one_hot(jnp.where(dispatch, pos, -1), capacity,
-                          dtype=jnp.bool_)                  # [N, E, C]
-    dispatch3 = slot & dispatch[..., None]
-    gate = jnp.max(gates * onehot, axis=-1)                 # [N] top-1 weight
-    combine = dispatch3.astype(jnp.float32) * gate[:, None, None]
-    return dispatch3, combine
+    dispatch, combine, _ = route_topk(router_logits, capacity, k=1)
+    return dispatch, combine
 
 
 def _route_and_bucket(router_w: jax.Array, x: jax.Array,
-                      capacity_factor: float, E: int):
-    """Shared routing prologue: capacity, top-1 dispatch/combine masks, and
-    the per-expert token buckets.  ONE implementation so the local oracle
-    and the distributed path cannot silently diverge."""
+                      capacity_factor: float, E: int, top_k: int = 1):
+    """Shared routing prologue: capacity, top-k dispatch/combine masks, the
+    per-expert token buckets, and the routing-health aux terms.  ONE
+    implementation so the local oracle and the distributed path cannot
+    silently diverge."""
     N, _ = x.shape
-    capacity = max(1, int(-(-N * capacity_factor // E)))
+    capacity = max(1, int(-(-N * capacity_factor * top_k // E)))
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)   # [N, E]
-    dispatch, combine = route_top1(logits, capacity)
+    dispatch, combine, aux = route_topk(logits, capacity, top_k)
     buckets = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
-    return combine, buckets, capacity
+    return combine, buckets, capacity, aux
 
 
 def _combine(combine_w: jax.Array, expert_out: jax.Array) -> jax.Array:
@@ -77,7 +130,8 @@ def _combine(combine_w: jax.Array, expert_out: jax.Array) -> jax.Array:
 
 def moe_ffn_local(expert_fn: Callable, stacked_params: PyTree,
                   router_w: jax.Array, x: jax.Array,
-                  capacity_factor: float = 1.25) -> jax.Array:
+                  capacity_factor: float = 1.25, top_k: int = 1,
+                  return_aux: bool = False):
     """Single-device mixture-of-experts (all experts resident): the same
     routing/dispatch/combine math as :func:`moe_ffn` with the all-to-all
     hops removed and the experts applied under ``vmap``.  This is both the
@@ -86,16 +140,21 @@ def moe_ffn_local(expert_fn: Callable, stacked_params: PyTree,
 
     ``stacked_params``: pytree whose leaves carry a leading expert axis
     ``[E, ...]``; ``expert_fn(params_e, tokens)`` applies ONE expert.
+    ``return_aux=True`` additionally returns the :func:`route_topk` aux
+    dict (balance loss + dropped fraction).
     """
     E = router_w.shape[1]
-    combine, buckets, _ = _route_and_bucket(router_w, x, capacity_factor, E)
+    combine, buckets, _, aux = _route_and_bucket(router_w, x,
+                                                 capacity_factor, E, top_k)
     out = jax.vmap(expert_fn)(stacked_params, buckets)      # [E, C, D]
-    return _combine(combine, out)
+    y = _combine(combine, out)
+    return (y, aux) if return_aux else y
 
 
 def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
             x: jax.Array, capacity_factor: float = 1.25,
-            axis_name: str = "expert") -> jax.Array:
+            axis_name: str = "expert", top_k: int = 1,
+            return_aux: bool = False):
     """Expert-parallel mixture-of-experts FFN (one expert per device).
 
     Args:
@@ -106,7 +165,10 @@ def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
       router_w: ``[D, E]`` router weights (replicated — every device must
         route identically).
       x: local tokens ``[N, D]`` (flatten batch/sequence first).
-      capacity_factor: bucket size ``C = ceil(N / E * factor)``.
+      capacity_factor: bucket size ``C = ceil(N * top_k / E * factor)``.
+      top_k: experts per token (1 = Switch, 2 = GShard).
+      return_aux: also return the :func:`route_topk` aux dict (balance
+        loss + dropped fraction) for this device's local tokens.
 
     Returns ``[N, D]``: gate-weighted expert outputs; capacity-dropped
     tokens contribute zeros (add the residual stream outside).
@@ -117,8 +179,8 @@ def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
         raise ValueError(
             f"router_w must be [{D}, {E}] (token dim x expert-axis size, "
             f"one expert per device), got {router_w.shape}")
-    combine, buckets, capacity = _route_and_bucket(router_w, x,
-                                                   capacity_factor, E)
+    combine, buckets, capacity, aux = _route_and_bucket(
+        router_w, x, capacity_factor, E, top_k)
     # all-to-all: device e receives every peer's bucket for expert e,
     # stacked along a peer axis -> [E_peers, C, D] -> one batched FFN call
     recv = lax.all_to_all(buckets, axis_name, split_axis=0, concat_axis=0,
@@ -128,4 +190,5 @@ def moe_ffn(expert_fn: Callable, expert_params: PyTree, router_w: jax.Array,
     # reverse hop: peers get their tokens back at the same coordinates
     home = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                           tiled=True)                       # [E, C, D]
-    return _combine(combine, home)
+    y = _combine(combine, home)
+    return (y, aux) if return_aux else y

@@ -34,8 +34,8 @@ PyTree = Any
 
 
 def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
-                   num_microbatches: int, axis_name: str = "pipe"
-                   ) -> jax.Array:
+                   num_microbatches: int, axis_name: str = "pipe",
+                   consume_fn: Callable | None = None) -> jax.Array:
     """Run ``x`` through ``S`` pipelined stages (``S`` = size of
     ``axis_name``).
 
@@ -49,9 +49,22 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
       x: the full local batch ``[B, ...]`` (replicated over the pipe axis);
         ``B`` must divide into ``num_microbatches`` equal microbatches.
       num_microbatches: GPipe ``M``; bubble = (S-1)/(M+S-1).
+      consume_fn: optional ``(out_mb, mb_index) -> scalar`` folding each
+        microbatch's LAST-stage output (e.g. its loss share) as it emerges
+        from the pipeline.  SPMD caveat: it executes every tick on every
+        rank (same program everywhere); only the last rank's valid ticks
+        are accumulated — the rest are masked to zero, so no gradient
+        flows from them.
 
-    Returns: ``[B, ...]`` outputs of the LAST stage, replicated over the
-    pipe axis (differentiable end to end).
+    Returns:
+      Without ``consume_fn``: ``[B, ...]`` outputs of the LAST stage,
+      replicated over the pipe axis (differentiable end to end).
+      With ``consume_fn``: the LOCAL share of ``Σ_mb consume_fn(out_mb,
+      mb)`` — nonzero only on the last rank; ``lax.psum`` it over
+      ``axis_name`` *outside* the differentiated region (psum transposes
+      to psum under shard_map).  This path never materializes the
+      ``[T, mb, ...]`` output stack and skips the output broadcast — the
+      scalar psum replaces a full [B, ...] collective.
     """
     S = lax.psum(1, axis_name)          # static under shard_map
     idx = lax.axis_index(axis_name)
@@ -75,14 +88,31 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
 
     fwd_perm = [(j, j + 1) for j in range(S - 1)]   # no wraparound
 
-    def tick(state, t):
+    def ingest(state, t):
         # stage 0 ingests microbatch t (zeros once exhausted); others take
         # the activation their predecessor ppermuted last tick
         feed = lax.dynamic_index_in_dim(mbs, jnp.minimum(t, M - 1), 0,
                                         keepdims=False)
         feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
-        h = jnp.where(idx == 0, feed.astype(zeros_state.dtype), state)
-        out = stage_fn(stage_params, h)
+        return jnp.where(idx == 0, feed.astype(zeros_state.dtype), state)
+
+    if consume_fn is not None:
+        def tick(carry, t):
+            state, acc = carry
+            out = stage_fn(stage_params, ingest(state, t))
+            m = t - (S - 1)          # microbatch index emerging this tick
+            val = consume_fn(out, jnp.maximum(m, 0))
+            acc = acc + jnp.where((idx == S - 1) & (m >= 0), val,
+                                  jnp.zeros_like(val))
+            return (lax.ppermute(out, axis_name, fwd_perm), acc), None
+
+        (_, acc), _ = lax.scan(tick, (zeros_state,
+                                      jnp.zeros((), jnp.float32)),
+                               jnp.arange(T))
+        return acc
+
+    def tick(state, t):
+        out = stage_fn(stage_params, ingest(state, t))
         nxt = lax.ppermute(out, axis_name, fwd_perm)
         return nxt, out
 

@@ -8,21 +8,25 @@ from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          build_eval_step, build_ea_steps,
                                          build_ea_cycle, reduce_confusion)
 from distlearn_tpu.train.lm import (LMEAState, build_lm_ea_steps,
+                                    build_lm_moe_metrics,
                                     build_lm_pp_step, build_lm_step,
                                     init_lm_ea_state, stack_blocks,
                                     unstack_blocks)
-from distlearn_tpu.train.optim import (OptaxTrainState, ZeroTrainState,
+from distlearn_tpu.train.optim import (LMZeroState, OptaxTrainState,
+                                       ZeroTrainState, build_lm_zero_step,
                                        build_optax_step,
                                        build_zero_optax_step,
-                                       init_optax_state, init_zero_state)
+                                       init_lm_zero_state, init_optax_state,
+                                       init_zero_state)
 
 __all__ = [
     "TrainState", "EATrainState", "init_train_state", "init_ea_state",
     "build_sgd_step", "build_sgd_scan_step", "build_sync_step",
     "build_eval_step", "build_ea_steps", "build_ea_cycle",
-    "reduce_confusion", "build_lm_step", "build_lm_pp_step",
-    "stack_blocks", "unstack_blocks",
+    "reduce_confusion", "build_lm_step", "build_lm_moe_metrics",
+    "build_lm_pp_step", "stack_blocks", "unstack_blocks",
     "LMEAState", "build_lm_ea_steps", "init_lm_ea_state",
     "OptaxTrainState", "build_optax_step", "init_optax_state",
     "ZeroTrainState", "build_zero_optax_step", "init_zero_state",
+    "LMZeroState", "build_lm_zero_step", "init_lm_zero_state",
 ]

@@ -23,6 +23,7 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                   data_axis: str = "data", seq_axis: str | None = "seq",
                   tp_axis: str | None = "model",
                   ep_axis: str | None = None, accum_steps: int = 1,
+                  moe_balance_weight: float = 0.0,
                   donate: bool = True) -> Callable:
     """``step(params, tokens) -> (params, loss)``.
 
@@ -70,7 +71,9 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
             return jax.value_and_grad(
                 lambda p: lm_loss(model, p, toks, seq_axis=seq_axis,
                                   tp_axis=tp_axis, ep_axis=ep_axis,
-                                  reduce=False))(params)
+                                  reduce=False,
+                                  moe_balance_weight=moe_balance_weight)
+                )(params)
 
         if accum_steps == 1:
             local_loss, grads = local_grad(tokens)
@@ -121,6 +124,39 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def build_lm_moe_metrics(model: Model, mesh: Mesh, params_template,
+                         data_axis: str = "data",
+                         seq_axis: str | None = "seq",
+                         tp_axis: str | None = "model",
+                         ep_axis: str | None = None) -> Callable:
+    """``metrics(params, tokens) -> {"moe_balance_loss", "moe_dropped_frac"}``
+    — routing-health monitor for MoE LMs (forward only, no grads): the mean
+    Switch balance loss (1.0 = perfectly balanced router) and the fraction
+    of routing assignments dropped by expert capacity.  Same mesh/sharding
+    contract as :func:`build_lm_step`; values are averaged over the
+    data/seq axes.  Run at report cadence, not every step."""
+    pspecs = param_specs(params_template, tp_axis, ep_axis)
+    axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
+
+    def metrics(params, tokens):
+        _, st = model.apply(params, {}, tokens, train=True,
+                            seq_axis=seq_axis, tp_axis=tp_axis,
+                            ep_axis=ep_axis)
+        if "moe_balance_loss" not in st:
+            raise ValueError("model returned no MoE routing metrics — "
+                             "build it with moe_experts > 0")
+        out = {"moe_balance_loss": st["moe_balance_loss"],
+               "moe_dropped_frac": st["moe_dropped_frac"]}
+        return {k: lax.pmean(v, axes) if axes else v
+                for k, v in out.items()}
+
+    tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    return jax.jit(jax.shard_map(
+        metrics, mesh=mesh, in_specs=(pspecs, tok_spec),
+        out_specs={"moe_balance_loss": P(), "moe_dropped_frac": P()},
+        check_vma=False))
+
+
 def stack_blocks(params, depth: int):
     """Split a :func:`transformer_lm` param pytree into
     ``(shared, stacked_blocks)``: the embed/pos/out_norm leaves, and the
@@ -144,68 +180,86 @@ def unstack_blocks(shared, stacked, depth: int):
 def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,
                      lr: float, num_microbatches: int,
                      compute_dtype=None, data_axis: str = "data",
-                     pipe_axis: str = "pipe",
+                     pipe_axis: str = "pipe", remat: bool = False,
                      donate: bool = True) -> Callable:
     """Pipeline-parallel LM train step over a ``(data, pipe)`` mesh:
     ``step(shared, stacked, tokens) -> (shared, stacked, loss)``.
 
-    One transformer block per pipeline stage (``depth == pipe axis size``);
-    microbatches stream through the stages via
+    ``k = depth / n_stages`` transformer blocks per pipeline stage (depth
+    must divide evenly; sharding the stacked ``[depth, ...]`` block axis
+    over ``pipe`` hands each stage its k contiguous blocks, scanned in
+    order inside the stage fn — ``remat=True`` checkpoints each block so
+    only one block's activations per in-flight microbatch stay live).
+    Microbatches stream through the stages via
     :func:`distlearn_tpu.parallel.pp.pipeline_apply`, so the whole GPipe
-    schedule — all ticks, forward and backward — is one XLA program.
-    Embedding/positional/head leaves (``shared``) are replicated over both
-    axes: in the forward they execute on every pipe rank for SPMD
-    uniformity, but gradient only flows on the ranks that use them (rank 0
-    ingests, the last rank computes the head), so their grads are SUMMED
-    over the pipe axis to reassemble and averaged over data.  Block leaves
-    are sharded one-stage-per-device over ``pipe`` (grads reduce over data
-    only).  Composes with data parallelism; TP/SP/MoE stay with
-    :func:`build_lm_step` — the two factorizations cover different model
-    regimes (PP for deep dense stacks whose params exceed one chip).
+    schedule — all ticks, forward and backward — is one XLA program, and
+    the microbatch count doubles as the gradient-accumulation lever.
+
+    Each microbatch's loss share is folded ON the last rank as it emerges
+    from the pipeline (``consume_fn``) — only a scalar psum crosses the
+    pipe axis, not the [B, L, D] activation broadcast, and head gradients
+    seed solely on the last rank (masked elsewhere), so no 1/S rescaling
+    is needed.  Embedding/positional/head leaves (``shared``) are
+    replicated over both axes; their partial grads (rank 0 ingests, last
+    rank computes the head) are SUMMED over pipe to reassemble and
+    averaged over data.  Block leaves are sharded k-per-device over
+    ``pipe`` (grads reduce over data only).  Composes with data
+    parallelism; TP/SP/MoE stay with :func:`build_lm_step` — the two
+    factorizations cover different model regimes (PP for deep dense
+    stacks whose params exceed one chip).
     """
     n_stages = mesh.shape[pipe_axis]
     depth = jax.tree_util.tree_leaves(stacked_template)[0].shape[0]
-    if depth != n_stages:
+    if depth % n_stages:
         raise ValueError(
-            f"stacked blocks hold {depth} stages but the {pipe_axis!r} "
-            f"axis has {n_stages} devices (one block per stage)")
+            f"stacked blocks hold {depth} layers but the {pipe_axis!r} "
+            f"axis has {n_stages} devices — depth must divide into an "
+            "equal number of blocks per stage")
     for need in ("embed", "pos", "out_norm"):
         if need not in shared_template:
             raise ValueError(f"shared params missing {need!r} — pass the "
                              "(shared, stacked) pair from stack_blocks()")
 
     def step(shared, stacked, tokens):
-        blk_local = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0),
-                                           stacked)
-        S = lax.psum(1, pipe_axis)
+        # local stacked leaves: [k, ...] — this stage's k contiguous blocks
+        B, L = tokens.shape
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"per-replica batch {B} not divisible into "
+                             f"{M} microbatches")
+        toks_mb = tokens.reshape(M, B // M, L)
 
         def local_loss(shared, blk_local):
             cd = compute_dtype or shared["embed"].dtype
-            B, L = tokens.shape
             x = shared["embed"][tokens].astype(cd)
             x = x + shared["pos"][:L].astype(cd)[None]
 
-            def stage(bp, h):
-                return block_apply(bp, h, cd)
+            one = lambda bp, h: block_apply(bp, h, cd)   # noqa: E731
+            if remat:
+                one = jax.checkpoint(one)
 
-            h = pipeline_apply(stage, blk_local, x, num_microbatches,
-                               axis_name=pipe_axis)
-            h = _rmsnorm(shared["out_norm"], h)
-            logits = (h @ shared["embed"].T.astype(cd)).astype(jnp.float32)
-            lp = jax.nn.log_softmax(logits[:, :-1])
-            targets = tokens[:, 1:]
-            nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
-            # THE PIPE-SHARE SCALING: every pipe rank computes this same
-            # loss from the broadcast pipeline output, so each rank seeds a
-            # full cotangent and the broadcast's psum-transpose multiplies
-            # the upstream gradient by S.  Differentiate the 1/S local
-            # share instead (the lm_loss reduce=False pattern for the seq
-            # axis) — grads come out exact, and the psum'd shares restore
-            # the true loss for reporting.
-            return nll.mean() / S
+            def stage(bp_stack, h):
+                h, _ = lax.scan(lambda hh, bp: (one(bp, hh), None),
+                                h, bp_stack)
+                return h
+
+            def consume(out_mb, m):
+                hh = _rmsnorm(shared["out_norm"], out_mb)
+                logits = (hh @ shared["embed"].T.astype(cd)
+                          ).astype(jnp.float32)
+                lp = jax.nn.log_softmax(logits[:, :-1])
+                tgt = lax.dynamic_index_in_dim(toks_mb, m, 0,
+                                               keepdims=False)[:, 1:]
+                nll = -jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+                # this microbatch's share of the global batch-mean loss
+                return nll.sum() / jnp.float32(B * (L - 1))
+
+            return pipeline_apply(stage, blk_local, x, M,
+                                  axis_name=pipe_axis, consume_fn=consume)
 
         local_share, (g_shared, g_blk) = jax.value_and_grad(
-            local_loss, argnums=(0, 1))(shared, blk_local)
+            local_loss, argnums=(0, 1))(shared, stacked)
+        # the share is nonzero only on the last rank: psum restores the loss
         loss = lax.psum(local_share, pipe_axis)
         dp = lax.psum(1, data_axis)
         # shared leaves: partial grads live on the pipe ranks that touched
@@ -220,10 +274,9 @@ def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,
         shared = jax.tree_util.tree_map(
             lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
             shared, g_shared)
-        blk_local = jax.tree_util.tree_map(
+        stacked_new = jax.tree_util.tree_map(
             lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
-            blk_local, g_blk)
-        stacked_new = jax.tree_util.tree_map(lambda a: a[None], blk_local)
+            stacked, g_blk)
         return shared, stacked_new, lax.pmean(loss, data_axis)
 
     mapped = jax.shard_map(

@@ -217,7 +217,8 @@ def _make_sgd_body(model: Model, tree: MeshTree, lr: float,
 
 def build_sgd_scan_step(model: Model, tree: MeshTree, lr: float,
                         donate: bool = True, fused: bool | None = None,
-                        max_bucket_bytes: int | None = None) -> Callable:
+                        max_bucket_bytes: int | None = None,
+                        with_contrib: bool = False) -> Callable:
     """K chained AllReduceSGD steps as ONE XLA program:
     ``steps(ts, xs, ys) -> (ts, losses)`` with ``xs``/``ys`` carrying a
     leading ``[K]`` step axis (replicated) over the normal data-sharded batch
@@ -231,22 +232,39 @@ def build_sgd_scan_step(model: Model, tree: MeshTree, lr: float,
     CIFAR-10 headline step) — the reference has the same structure cost in
     every ``tree.allReduce`` socket round trip (SURVEY.md §3.1), which this
     design removes entirely.  K is read from the input shape at trace time.
+
+    ``with_contrib=True`` adds a 4th argument ``[K, num_nodes]`` of 0/1
+    participation flags (sharded over the axis), one row per chained step —
+    the per-call step's uneven-data-partition masking
+    (lua/AllReduceSGD.lua:22-27) on the scanned hot path: each step's row
+    masks grads/steps/metrics exactly as :func:`build_sgd_step`'s
+    ``with_contrib`` does per call.
     """
     axis = tree.axis_name
     _body = _make_sgd_body(model, tree, lr, fused, max_bucket_bytes)
 
-    def steps(ts, xs, ys):
-        def scan_body(carry, xy):
-            x, y = xy
-            new_ts, loss = _body(carry, x, y, None)
-            return new_ts, loss
-        ts, losses = lax.scan(scan_body, ts, (xs, ys))
-        return ts, losses
-
     specs_ts = TrainState(params=P(), model_state=P(), sync=P(axis),
                           cm=P(axis), rng=P())
+    if with_contrib:
+        def steps(ts, xs, ys, contribs):
+            def scan_body(carry, xyc):
+                x, y, c = xyc
+                new_ts, loss = _body(carry, x, y, jnp.squeeze(c, 0))
+                return new_ts, loss
+            ts, losses = lax.scan(scan_body, ts, (xs, ys, contribs))
+            return ts, losses
+        in_specs = (specs_ts, P(None, axis), P(None, axis), P(None, axis))
+    else:
+        def steps(ts, xs, ys):
+            def scan_body(carry, xy):
+                x, y = xy
+                new_ts, loss = _body(carry, x, y, None)
+                return new_ts, loss
+            ts, losses = lax.scan(scan_body, ts, (xs, ys))
+            return ts, losses
+        in_specs = (specs_ts, P(None, axis), P(None, axis))
     mapped = jax.shard_map(steps, mesh=tree.mesh,
-                           in_specs=(specs_ts, P(None, axis), P(None, axis)),
+                           in_specs=in_specs,
                            out_specs=(specs_ts, P()),
                            check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -201,10 +201,13 @@ def restore_sharded_checkpoint(directory: str, like: PyTree,
     if not files:
         raise FileNotFoundError(f"no shard files for step {step}")
     assembled: dict[str, np.ndarray] = {}
-    # unique regions written per leaf: overlap-deduped, so a replicated
-    # region arriving from several hosts counts once and a genuinely
-    # missing shard file cannot be masked by double-counted duplicates
-    regions: dict[str, set] = {}
+    # written regions per leaf (lists of index tuples).  Coverage is
+    # validated element-exactly below with a bool mask built ONE leaf at a
+    # time (peak extra memory = largest leaf, not the whole tree):
+    # replicated regions count once, partially overlapping regions (e.g. a
+    # save retried under a different shard layout) cannot double-count,
+    # and a genuinely missing shard file always leaves unset bits
+    regions: dict[str, list] = {}
     meta0: dict = {}
     for name in files:
         with np.load(os.path.join(directory, name),
@@ -222,10 +225,10 @@ def restore_sharded_checkpoint(directory: str, like: PyTree,
                 if leaf_key not in assembled:
                     assembled[leaf_key] = np.empty(
                         tuple(glob["shape"]), np.dtype(glob["dtype"]))
-                    regions[leaf_key] = set()
+                    regions[leaf_key] = []
                 idx = tuple(slice(a, b) for a, b in info["index"])
                 assembled[leaf_key][idx] = z[skey]
-                regions[leaf_key].add(tuple(map(tuple, info["index"])))
+                regions[leaf_key].append(idx)
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
     for pathspec, leaf in leaves_with_path:
@@ -233,9 +236,11 @@ def restore_sharded_checkpoint(directory: str, like: PyTree,
         if key not in assembled:
             raise KeyError(f"sharded checkpoint missing leaf {key!r}")
         arr = assembled[key]
-        covered = sum(
-            int(np.prod([b - a for a, b in region])) if region else 1
-            for region in regions[key])
+        mask = np.zeros(arr.shape, np.bool_)
+        for idx in regions[key]:
+            mask[idx] = True
+        covered = int(np.count_nonzero(mask))
+        del mask
         if covered < arr.size:
             raise ValueError(
                 f"leaf {key!r}: shard files cover {covered} of "

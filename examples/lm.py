@@ -28,8 +28,8 @@ def main():
         "dp": (2, "data-parallel mesh axis size"),
         "sp": (2, "sequence-parallel axis size (ring attention shards)"),
         "tp": (2, "tensor-parallel axis size (Megatron projections)"),
-        "pp": (0, "pipeline-parallel stages (GPipe, one block per stage; "
-                  "requires --sp 1 --tp 1 and --depth == --pp)"),
+        "pp": (0, "pipeline-parallel stages (GPipe, depth/pp blocks per "
+                  "stage; requires --sp 1 --tp 1 and --depth % --pp == 0)"),
         "microbatches": (4, "GPipe microbatches per step (with --pp)"),
         "dim": (128, "model width"),
         "depth": (4, "number of blocks"),
@@ -41,6 +41,10 @@ def main():
         "seqImpl": ("ring", "sequence attention: ring | alltoall"),
         "moeExperts": (0, "experts per MoE block (0 = dense; must equal "
                           "--dp, experts shard over the data axis)"),
+        "moeTopK": (1, "experts per token (1 = Switch, 2 = GShard)"),
+        "moeBalanceWeight": (0.01, "Switch load-balancing auxiliary loss "
+                                   "weight (0 disables; without it top-1 "
+                                   "routing collapses onto few experts)"),
         "remat": (False, "jax.checkpoint each block (long-context memory)"),
         "accumSteps": (1, "gradient-accumulation microbatches per step "
                           "(memory lever; effective batch unchanged)"),
@@ -55,13 +59,13 @@ def main():
             raise SystemExit("--pp composes with data parallelism only: "
                              "pass --sp 1 --tp 1 (PP and TP/SP cover "
                              "different model regimes)")
-        if opt.depth != opt.pp:
-            raise SystemExit(f"--pp {opt.pp} needs --depth {opt.pp} "
-                             "(one block per stage)")
-        if opt.accumSteps != 1 or opt.remat or opt.moeExperts:
-            raise SystemExit("--pp does not support --accumSteps/--remat/"
+        if opt.depth % opt.pp:
+            raise SystemExit(f"--pp {opt.pp} needs --depth divisible by "
+                             f"{opt.pp} (equal blocks per stage)")
+        if opt.accumSteps != 1 or opt.moeExperts:
+            raise SystemExit("--pp does not support --accumSteps/"
                              "--moeExperts (GPipe microbatching IS the "
-                             "accumulation/memory lever on this path; MoE "
+                             "accumulation lever on this path; MoE "
                              "needs the expert axis of the non-pp step)")
     n_dev = opt.dp * opt.sp * opt.tp * max(1, opt.pp)
     setup_platform(n_dev, opt.tpu)
@@ -76,7 +80,8 @@ def main():
 
     from distlearn_tpu.models.transformer import (lm_loss, param_specs,
                                                   transformer_lm)
-    from distlearn_tpu.train.lm import (build_lm_pp_step, build_lm_step,
+    from distlearn_tpu.train.lm import (build_lm_moe_metrics,
+                                        build_lm_pp_step, build_lm_step,
                                         stack_blocks)
     from distlearn_tpu.utils.logging import root_print
     from distlearn_tpu.utils.profiling import StepTimer, trace
@@ -95,7 +100,7 @@ def main():
         heads=max(4, opt.dim // 64), max_len=opt.seqLen,
         compute_dtype=cdtype,
         seq_impl=opt.seqImpl, remat=opt.remat,
-        moe_experts=opt.moeExperts)
+        moe_experts=opt.moeExperts, moe_top_k=opt.moeTopK)
     params, _ = lm.init(random.PRNGKey(opt.seed))
     if opt.pp:
         mesh = Mesh(np.array(devs[:n_dev]).reshape(opt.dp, opt.pp),
@@ -108,7 +113,7 @@ def main():
         pp_step = build_lm_pp_step(mesh, shared, stacked,
                                    lr=opt.learningRate,
                                    num_microbatches=opt.microbatches,
-                                   compute_dtype=cdtype)
+                                   compute_dtype=cdtype, remat=opt.remat)
         state = {"shared": shared, "stacked": stacked}
 
         def step(st, tokens):
@@ -124,12 +129,17 @@ def main():
             + (f"; {opt.moeExperts} experts" if opt.moeExperts else ""))
         ep_axis = "data" if opt.moeExperts else None
         step = build_lm_step(lm, mesh, params, lr=opt.learningRate,
-                             ep_axis=ep_axis, accum_steps=opt.accumSteps)
+                             ep_axis=ep_axis, accum_steps=opt.accumSteps,
+                             moe_balance_weight=(opt.moeBalanceWeight
+                                                 if opt.moeExperts else 0.0))
         params = jax.device_put(
             params, jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s),
                 param_specs(params, tp_axis="model", ep_axis=ep_axis)))
         tok_spec = P("data", "seq")
+        if opt.moeExperts:
+            moe_metrics = build_lm_moe_metrics(lm, mesh, params,
+                                               ep_axis=ep_axis)
 
     # Synthetic corpus: order-2 Markov tokens — learnable next-token
     # structure without any dataset download (zero-egress env).
@@ -165,7 +175,13 @@ def main():
                 stack.close()
                 log(f"profiler trace written to {opt.profile}")
             if i % 10 == 0 or i == opt.steps:
-                log(f"step {i}: loss {float(loss):.4f} "
+                extra = ""
+                if opt.moeExperts and not opt.pp:
+                    m = jax.device_get(moe_metrics(params, tokens))
+                    extra = (f" [router balance "
+                             f"{float(m['moe_balance_loss']):.3f}, dropped "
+                             f"{float(m['moe_dropped_frac']):.3f}]")
+                log(f"step {i}: loss {float(loss):.4f}{extra} "
                     f"({timer.steps_per_sec():.2f} steps/s)")
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
     log("done")

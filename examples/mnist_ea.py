@@ -100,6 +100,7 @@ def main():
     cycle = (build_ea_cycle(model, tree, lr=opt.learningRate, alpha=opt.alpha,
                             momentum=opt.momentum) if opt.scanCycle else None)
     timer = StepTimer()
+    last_report = global_step   # scanCycle cadence: steps since last report
     for epoch in range(start_epoch, opt.numEpochs + 1):
         sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
         if opt.scanCycle:
@@ -114,7 +115,11 @@ def main():
                 ets, losses = cycle(ets, sxs, sys_)
                 timer.tick(steps=k)   # interval since last tick = this cycle
                 global_step += k
-                if (global_step // tau) % max(1, opt.reportEvery // tau) == 0:
+                # explicit steps-since-last-report: robust to a shorter
+                # final group making global_step a non-multiple of tau, and
+                # to reportEvery < tau (at most one report per cycle)
+                if global_step - last_report >= opt.reportEvery:
+                    last_report = global_step
                     cm = reduce_confusion(ets.cm)
                     log(f"step {global_step} loss "
                         f"{float(np.mean(np.asarray(losses))):.4f} "

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distlearn_tpu.parallel.ep import moe_ffn, route_top1
+from distlearn_tpu.parallel.ep import moe_ffn, route_top1, route_topk
 
 E, N, D = 4, 12, 8      # 4 experts/devices, 12 tokens per device
 
@@ -97,6 +97,87 @@ def test_route_top1_positions_unique():
     assert per_slot.max() <= 1                        # no slot collisions
     # every token whose expert had room is dispatched exactly once
     assert np.asarray(dispatch.sum(axis=(1, 2))).max() <= 1
+
+
+def _dense_top2_reference(params, x_all):
+    """Dense GShard top-2: both chosen experts run, gates renormalized
+    over the two picks."""
+    out = []
+    for dev in range(E):
+        x = x_all[dev]
+        gates = jax.nn.softmax(x @ params["router"], axis=-1)     # [N, E]
+        topv, topi = jax.lax.top_k(gates, 2)                      # [N, 2]
+        w = topv / topv.sum(-1, keepdims=True)
+        ys = jnp.stack([_expert(params["experts"][e], x)
+                        for e in range(E)], axis=1)               # [N, E, D]
+        y = sum(jnp.take_along_axis(ys, topi[:, j][:, None, None], 1)[:, 0]
+                * w[:, j][:, None] for j in range(2))
+        out.append(y)
+    return jnp.stack(out)
+
+
+def test_moe_top2_matches_dense_reference():
+    """The distributed top-2 (GShard) path with non-binding capacity must
+    equal the dense run-both-experts reference, forward and backward."""
+    mesh = Mesh(np.array(jax.devices()[:E]), ("expert",))
+    params = _params(5)
+    x_all = jnp.asarray(np.random.RandomState(6).randn(E, N, D)
+                        .astype(np.float32))
+
+    def fn(p, xx):
+        ep = jnp.squeeze(p["experts"], 0)
+        y = moe_ffn(_expert, ep, p["router"], jnp.squeeze(xx, 0),
+                    capacity_factor=float(E), axis_name="expert", top_k=2)
+        return y[None]
+
+    moe2 = jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=({"experts": P("expert"), "router": P()}, P("expert")),
+        out_specs=P("expert"), check_vma=False))
+    out = moe2(params, x_all)
+    ref = _dense_top2_reference(params, x_all)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    g = jax.grad(lambda p: jnp.sum(moe2(p, x_all) ** 2))(params)
+    g_ref = jax.grad(lambda p: jnp.sum(_dense_top2_reference(p, x_all) ** 2)
+                     )(params)
+    for k in ("experts", "router"):
+        np.testing.assert_allclose(np.asarray(g[k]), np.asarray(g_ref[k]),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_route_topk_aux_terms():
+    """balance_loss is 1.0 for a perfectly uniform router and > 1 when
+    skewed; dropped_frac counts capacity-dropped assignments exactly."""
+    # uniform: every expert equally probable AND equally chosen
+    N2 = 4 * E
+    logits = jnp.zeros((N2, E), jnp.float32)
+    # argmax ties break to expert 0 — build an exactly-cycling assignment
+    # with small biases; P_e stays exactly 1/E by symmetry (each expert is
+    # boosted in the same fraction of tokens)
+    bias = 1e-3 * jax.nn.one_hot(jnp.arange(N2) % E, E)
+    _, _, aux = route_topk(logits + bias, capacity=N2, k=1)
+    np.testing.assert_allclose(float(aux["balance_loss"]), 1.0, rtol=1e-4)
+    assert float(aux["dropped_frac"]) == 0.0
+    # fully collapsed: all tokens pick expert 0 with prob ~1 -> loss ~ E
+    big = jnp.zeros((N2, E), jnp.float32).at[:, 0].set(20.0)
+    _, _, aux = route_topk(big, capacity=N2, k=1)
+    np.testing.assert_allclose(float(aux["balance_loss"]), float(E),
+                               rtol=1e-3)
+    # capacity 1: E tokens kept of N2 assignments
+    d3, _, aux = route_topk(big, capacity=1, k=1)
+    assert float(aux["dropped_frac"]) == (N2 - 1) / N2
+
+
+def test_route_top2_slots_unique_and_rank_priority():
+    logits = jnp.asarray(np.random.RandomState(7).randn(64, E), jnp.float32)
+    dispatch, combine, _ = route_topk(logits, capacity=16, k=2)
+    per_slot = np.asarray(dispatch.sum(axis=0))       # [E, C]
+    assert per_slot.max() <= 1                        # no slot collisions
+    # each token dispatched at most twice (its two experts)
+    assert np.asarray(dispatch.sum(axis=(1, 2))).max() <= 2
+    # combine weights of kept assignments sum to at most 1 per token
+    assert float(np.asarray(combine).sum(axis=(1, 2)).max()) <= 1.0 + 1e-5
 
 
 def test_moe_rejects_wrong_router_shape():

@@ -110,10 +110,9 @@ def test_lm_gradient_accumulation_matches_full():
                                    rtol=1e-5, atol=1e-6)
 
 
-def test_lm_pp_step_matches_sequential():
-    """The pipeline-parallel LM step (dp2 x pipe4, one block per stage,
-    GPipe microbatches) must match the plain single-mesh LM step: same
-    loss, same updated params (the gradient reassembly across pipe ranks
+def _pp_vs_sequential(depth, n_stages, num_microbatches, remat):
+    """PP step on dp2 x pipe{n_stages} vs the plain single-mesh LM step:
+    same loss, same updated params (gradient reassembly across pipe ranks
     is exact)."""
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -122,7 +121,7 @@ def test_lm_pp_step_matches_sequential():
     from distlearn_tpu.train import (build_lm_pp_step, build_lm_step,
                                      stack_blocks, unstack_blocks)
 
-    depth, dim, vocab, L, B = 4, 32, 64, 16, 8
+    dim, vocab, L, B = 32, 64, 16, 8
     lm = transformer_lm(vocab=vocab, dim=dim, depth=depth, heads=2,
                         max_len=L)
     params, _ = lm.init(jax.random.PRNGKey(0))
@@ -137,13 +136,14 @@ def test_lm_pp_step_matches_sequential():
                            NamedSharding(mesh1, P("data", "seq")))
     p_ref, loss_ref = step_ref(params, t_ref)
 
-    # pipelined: dp2 x pipe4
-    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("data", "pipe"))
+    mesh = Mesh(np.array(jax.devices()[:2 * n_stages]).reshape(2, n_stages),
+                ("data", "pipe"))
     shared, stacked = stack_blocks(params, depth)
     shared_d = jax.device_put(shared, NamedSharding(mesh, P()))
     stacked_d = jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
     step_pp = build_lm_pp_step(mesh, shared, stacked, lr=0.1,
-                               num_microbatches=2, donate=False)
+                               num_microbatches=num_microbatches,
+                               remat=remat, donate=False)
     t_pp = jax.device_put(tokens, NamedSharding(mesh, P("data")))
     shared_n, stacked_n, loss_pp = step_pp(shared_d, stacked_d, t_pp)
 
@@ -159,6 +159,16 @@ def test_lm_pp_step_matches_sequential():
         assert str(pa) == str(pb)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6, err_msg=str(pa))
+
+
+def test_lm_pp_step_matches_sequential():
+    _pp_vs_sequential(depth=4, n_stages=4, num_microbatches=2, remat=False)
+
+
+def test_lm_pp_step_k_blocks_per_stage_remat():
+    """depth=8 over 4 stages (k=2 blocks per stage) with per-block remat —
+    the generalized GPipe path — still matches the sequential step."""
+    _pp_vs_sequential(depth=8, n_stages=4, num_microbatches=4, remat=True)
 
 
 def test_lm_ea_diverge_contract_converge():

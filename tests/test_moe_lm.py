@@ -89,6 +89,57 @@ def test_moe_lm_ep_step_matches_single_device():
             err_msg=jax.tree_util.keystr(path))
 
 
+def test_moe_balance_loss_rebalances_collapsed_router():
+    """The Switch auxiliary loss must actively push a skewed router back
+    toward balance, where the plain LM loss leaves the skew in place —
+    the failure mode of top-1 routing the aux term exists for
+    (arXiv:2101.03961 §2.2).  Start from a router biased onto expert 0
+    and train with and without the aux term."""
+    from distlearn_tpu.train.lm import build_lm_moe_metrics
+
+    lm = transformer_lm(vocab=V, dim=DIM, depth=DEPTH, heads=HEADS,
+                        max_len=L, moe_experts=4, moe_every=2,
+                        moe_capacity_factor=1.0)
+    params0, _ = lm.init(random.PRNGKey(0))
+    # collapse the router: W = [w, -w, 0, 0] — tokens with h@w > 0 go to
+    # expert 0, the rest to expert 1, experts 2/3 are starved, and the
+    # sharpening factor aligns the gate probabilities with the usage so
+    # the f·P balance loss sees the collapse (~1.8 vs 1.0 balanced)
+    w = params0["block1"]["router"][:, :1] * 4.0
+    z = jnp.zeros_like(w)
+    params0["block1"]["router"] = jnp.concatenate([w, -w, z, z], axis=1)
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("data", "seq", "model"))
+    metrics = build_lm_moe_metrics(lm, mesh, params0, seq_axis=None,
+                                   tp_axis=None)
+    toks = _tokens(3)
+
+    def train(weight, steps=60):
+        step = build_lm_step(lm, mesh, params0, lr=0.2, seq_axis=None,
+                             tp_axis=None, moe_balance_weight=weight,
+                             donate=False)
+        p = params0
+        for _ in range(steps):
+            p, _ = step(p, toks)
+        return metrics(p, toks)
+
+    m0 = jax.device_get(metrics(params0, toks))
+    assert float(m0["moe_balance_loss"]) > 1.5   # skew is real at init
+    m_no = jax.device_get(train(0.0))
+    m_aux = jax.device_get(train(1.0))
+    bal_no = float(m_no["moe_balance_loss"])
+    bal_aux = float(m_aux["moe_balance_loss"])
+    # without the aux term the router stays collapsed (nothing pushes it
+    # back); with it, balance is restored most of the way toward 1.0
+    assert bal_no > 1.5, (bal_no, bal_aux)
+    assert bal_aux < 1.25, (bal_no, bal_aux)
+    assert bal_aux < bal_no - 0.25
+    # capacity 1.0 + collapse = drops; the rebalanced router drops less
+    assert float(m_aux["moe_dropped_frac"]) \
+        <= float(m_no["moe_dropped_frac"])
+
+
 def test_moe_config_validation():
     import pytest
     with pytest.raises(ValueError, match="silently train dense"):
