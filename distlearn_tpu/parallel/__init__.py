@@ -4,6 +4,7 @@ from distlearn_tpu.parallel.mesh import MeshTree, all_reduce, broadcast_from, no
 from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
 from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
+                                             AsyncEAServerConcurrent,
                                              AsyncEATester)
 from distlearn_tpu.parallel.sequence import (ring_attention, local_attention,
                                              alltoall_attention)
@@ -20,6 +21,7 @@ __all__ = [
     "AllReduceSGD",
     "AllReduceEA",
     "AsyncEAServer",
+    "AsyncEAServerConcurrent",
     "AsyncEAClient",
     "AsyncEATester",
     "ring_attention",

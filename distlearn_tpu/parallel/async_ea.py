@@ -194,12 +194,13 @@ class AsyncEAServer:
             print_server(f"received delta from client #{self.current_client}")
             return _rebuild(params, [t.copy() for t in self.center])
 
-    def test_net(self) -> bool:
+    def test_net(self, tensors: list[np.ndarray] | None = None) -> bool:
         """Push the center to the tester (ref ``testNet``, lua :239-258).
 
         A dead/hung tester must not stall training: the handshake runs
         under ``handshake_timeout`` and a failed tester is dropped (later
-        calls no-op, returning False)."""
+        calls no-op, returning False).  ``tensors`` overrides the pushed
+        leaves (the concurrent server passes an atomic snapshot)."""
         conn = self.test_conn
         if conn is None:
             return False
@@ -207,7 +208,7 @@ class AsyncEAServer:
             conn.set_timeout(self.handshake_timeout)
             conn.send_msg(TEST_Q)
             _expect(conn, CENTER_Q)
-            for t in self.center:
+            for t in (tensors if tensors is not None else self.center):
                 conn.send_tensor(t)
             _expect(conn, ACK)
             conn.set_timeout(None)
@@ -225,6 +226,215 @@ class AsyncEAServer:
             s.close()
         if self.test_server:
             self.test_server.close()
+
+
+class AsyncEAServerConcurrent(AsyncEAServer):
+    """Concurrent parameter-server: same wire protocol (clients and testers
+    connect unchanged), but handshakes for different clients OVERLAP — the
+    north-star scaling the reference's one-at-a-time critical section
+    (lua/AsyncEA.lua:163-177) rules out.
+
+    Structure: a dispatcher thread drains ``Enter?`` requests from the
+    broadcast channel and routes a token to the requesting client's worker
+    thread; each worker owns that client's dedicated channel exclusively
+    (the framed transport separates channels, so streams never interleave)
+    and runs the full center-down/delta-up handshake concurrently with the
+    other workers.  The center itself stays atomic: workers SNAPSHOT it
+    under a lock (then stream without blocking appliers) and APPLY deltas
+    under the same lock — a client never receives a torn center, and
+    ``center += delta`` remains serialized.  Relaxation vs the serial
+    server: two overlapping clients may both fetch the pre-update center
+    and push deltas computed against it — the standard stale-gradient
+    asynchrony EASGD is built to tolerate (arXiv:1412.6651 §4), traded for
+    N-way IO overlap.
+
+    ``pin_device`` pins the center on a jax device with a jitted donated
+    ``center += delta`` apply (the BASELINE.json north-star "one-sided
+    update against a pinned center replica"); host numpy otherwise.
+    Note: worth it when the accelerator is locally attached — on a
+    remote-tunneled chip the per-sync device round trip dominates.
+    """
+
+    def __init__(self, host: str, port: int, num_nodes: int,
+                 with_tester: bool = False, accept_timeout: float = 120.0,
+                 handshake_timeout: float | None = 30.0,
+                 pin_device=None):
+        super().__init__(host, port, num_nodes, with_tester=with_tester,
+                         accept_timeout=accept_timeout,
+                         handshake_timeout=handshake_timeout)
+        import queue
+        import threading
+        self._lock = threading.Lock()
+        self._queues = [queue.Queue() for _ in range(num_nodes)]
+        self._threads: list = []
+        self._stop = threading.Event()
+        self._dispatch_closed = threading.Event()
+        self._inflight = 0
+        self._sync_count = 0
+        self._device = pin_device
+        self._dev_center = None
+        self._dev_apply = None
+
+    # -- center storage ------------------------------------------------------
+    def init_server(self, params: PyTree):
+        super().init_server(params)
+        if self._device is not None:
+            self._pin()
+
+    def _pin(self):
+        """Move the center to the device; build the donated fused apply."""
+        self._dev_center = [jax.device_put(t, self._device)
+                            for t in self.center]
+
+        def _apply(center, deltas):
+            return [c + d.astype(c.dtype) for c, d in zip(center, deltas)]
+
+        self._dev_apply = jax.jit(_apply, donate_argnums=(0,))
+
+    def _snapshot(self) -> list[np.ndarray]:
+        with self._lock:
+            if self._dev_center is not None:
+                return [np.asarray(jax.device_get(t))
+                        for t in self._dev_center]
+            return [t.copy() for t in self.center]
+
+    def _apply_delta(self, deltas: list[np.ndarray]):
+        with self._lock:
+            if self._dev_center is not None:
+                self._dev_center = self._dev_apply(
+                    self._dev_center,
+                    [jax.device_put(d, self._device) for d in deltas])
+            else:
+                for t, d in zip(self.center, deltas):
+                    t += d.astype(t.dtype)
+            self._sync_count += 1
+
+    @property
+    def syncs_completed(self) -> int:
+        with self._lock:
+            return self._sync_count
+
+    @property
+    def drained(self) -> bool:
+        """True once no further syncs can arrive: every broadcast channel
+        has closed (the dispatcher exited) and no handshake is in flight —
+        the concurrent counterpart of the serial loop's
+        RuntimeError-from-recv_any stop condition (a serve loop polling
+        ``syncs_completed`` must also stop on this, or finished clients
+        would leave it spinning forever)."""
+        if not self._dispatch_closed.is_set():
+            return False
+        with self._lock:
+            inflight = self._inflight
+        return inflight == 0 and all(q.empty() for q in self._queues)
+
+    def current_center(self, params: PyTree) -> PyTree:
+        """Snapshot of the center as a pytree shaped like ``params``."""
+        return _rebuild(params, self._snapshot())
+
+    def test_net(self, tensors: list[np.ndarray] | None = None) -> bool:
+        """Tester push from an atomic snapshot (the live host list may be
+        mid-apply on a worker thread; the device copy is authoritative when
+        pinned).  The snapshot is passed down explicitly — NEVER by
+        swapping ``self.center``, which a concurrent ``_apply_delta``
+        iterates."""
+        if self.test_conn is None:
+            return False
+        return super().test_net(tensors if tensors is not None
+                                else self._snapshot())
+
+    # -- threads -------------------------------------------------------------
+    def start(self):
+        """Spawn the dispatcher + one worker per client.  Returns self."""
+        import threading
+        self._threads = [threading.Thread(target=self._dispatch, daemon=True)]
+        self._threads += [
+            threading.Thread(target=self._worker, args=(cid,), daemon=True)
+            for cid in range(1, self.num_nodes + 1)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=10.0)
+
+    def _dispatch(self):
+        try:
+            self._dispatch_loop()
+        finally:
+            self._dispatch_closed.set()
+
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            try:
+                idx, msg = self.broadcast.recv_any(timeout=0.5)
+            except TimeoutError:
+                continue
+            except (ConnectionError, OSError, RuntimeError):
+                # RuntimeError: every broadcast conn closed (all clients
+                # finished/evicted) — dispatch is done
+                return
+            if not isinstance(msg, dict) or msg.get("q") != ENTER_Q:
+                try:
+                    self.broadcast.conns[idx].close()
+                except OSError:
+                    pass
+                print_server(f"dropping peer with bad request {msg!r}")
+                continue
+            try:
+                cid = int(msg.get("clientID", -1))
+            except (TypeError, ValueError):
+                cid = -1
+            if not 1 <= cid <= self.num_nodes or cid in self.evicted:
+                try:
+                    self.broadcast.conns[idx].close()
+                except OSError:
+                    pass
+                continue
+            self._cid_to_broadcast[cid] = idx
+            with self._lock:
+                self._inflight += 1     # token issued; worker will settle it
+            self._queues[cid - 1].put(ENTER)
+
+    def _worker(self, cid: int):
+        conn = self.dedicated[cid - 1]
+        while not self._stop.is_set():
+            token = self._queues[cid - 1].get()
+            if token is None:
+                return
+            try:
+                try:
+                    conn.set_timeout(self.handshake_timeout)
+                    conn.send_msg(ENTER)
+                    _expect(conn, CENTER_Q)
+                    for t in self._snapshot():     # stream OUTSIDE the lock
+                        conn.send_tensor(t)
+                    _expect(conn, DELTA_Q)
+                    conn.send_msg(DELTA)
+                    deltas = [conn.recv_tensor() for _ in self.center]
+                    conn.set_timeout(None)
+                except (TimeoutError, ConnectionError, ProtocolError,
+                        OSError, ValueError) as e:
+                    self._evict(cid, e)
+                    # settle any stale tokens for the dead client so
+                    # ``drained`` cannot wedge on its queue
+                    import queue as _q
+                    while True:
+                        try:
+                            self._queues[cid - 1].get_nowait()
+                        except _q.Empty:
+                            break
+                        with self._lock:
+                            self._inflight -= 1
+                    return
+                self._apply_delta(deltas)      # full delta only, atomically
+            finally:
+                with self._lock:
+                    self._inflight -= 1
 
 
 class AsyncEAClient:

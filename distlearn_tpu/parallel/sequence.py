@@ -40,8 +40,10 @@ def _block_attn(q, k, v, scale, mask):
     m_safe = jnp.where(jnp.isneginf(m_blk), 0.0, m_blk)
     s_exp = jnp.exp(scores - m_safe[..., None])           # [B,H,Lq,Lk]
     s_exp = jnp.where(jnp.isneginf(scores), 0.0, s_exp)
-    o_blk = jnp.einsum("bhqk,bkhd->bhqd", s_exp,
-                       v.astype(jnp.float32))
+    # AV in the value dtype with f32 accumulation (bf16 MXU path on bf16
+    # configs; identical math for f32) — softmax stats stay f32 throughout
+    o_blk = jnp.einsum("bhqk,bkhd->bhqd", s_exp.astype(v.dtype), v,
+                       preferred_element_type=jnp.float32)
     return m_safe, s_exp.sum(-1), o_blk
 
 
@@ -61,7 +63,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     my = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
-    qf = q.astype(jnp.float32)
 
     q_pos = my * Lq + jnp.arange(Lq)                      # global q positions
 
@@ -73,8 +74,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = None
-        m_blk, l_blk, o_blk = _block_attn(qf, k_cur.astype(jnp.float32),
-                                          v_cur, scale, mask)
+        m_blk, l_blk, o_blk = _block_attn(q, k_cur, v_cur, scale, mask)
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)                        # rescale old acc
         beta = jnp.exp(m_blk - m_new)
@@ -175,12 +175,16 @@ def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 f"L={L}. Drop flash=True to use the portable path.")
         # env-enabled but unsupported here: portable fallback
     scale = 1.0 / (D ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32),
+    # native-dtype inputs + f32 ACCUMULATION: on bf16 configs the MXU runs
+    # bf16 matmuls accumulating in f32 (upcasting the operands instead
+    # would force f32 matmuls — 8x slower on the systolic array — and f32
+    # score traffic; for f32 models this is identical math)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         pos = jnp.arange(L)
         scores = jnp.where(pos[:, None] >= pos[None, :], scores, -jnp.inf)
-    w = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bhqd", w, v.astype(jnp.float32))
+    w = jax.nn.softmax(scores, axis=-1)   # stays f32 (stable softmax)
+    out = jnp.einsum("bhqk,bkhd->bhqd", w.astype(q.dtype), v,
+                     preferred_element_type=jnp.float32)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

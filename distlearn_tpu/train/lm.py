@@ -24,6 +24,8 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                   tp_axis: str | None = "model",
                   ep_axis: str | None = None, accum_steps: int = 1,
                   moe_balance_weight: float = 0.0,
+                  fused: bool | None = None,
+                  max_bucket_bytes: int | None = None,
                   donate: bool = True) -> Callable:
     """``step(params, tokens) -> (params, loss)``.
 
@@ -52,9 +54,23 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
     expert capacity is computed per ROUTING CALL, so microbatching rounds
     bucket sizes and decides overflow drops per microbatch — training is
     still correct, but not bit-identical to the single-shot step.
+
+    ``fused=True`` routes the SGD update through the Pallas packed-bucket
+    kernel.  DEFAULT OFF for the LM family — measured on the v5e it is a
+    LOSS here (dim 4096: 0.335 vs 0.580 MFU; dim 1024: 0.303 vs 0.341),
+    the opposite of the classifier result (1.43x win): packing a
+    ~800M-param tree into flat buckets costs two multi-GB concatenate
+    passes, while XLA's per-leaf update fusions consume each gradient
+    where it is produced with no extra materialization.  Kept as an
+    option because the crossover favors packing for small trees
+    (docs/PERF.md "fused update" note).  Applies only when every grad
+    leaf's dtype matches its param leaf; falls back per-leaf otherwise.
     """
+    from distlearn_tpu.ops import flatten as flatten_lib
+    from distlearn_tpu.ops import fused_update
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    use_fused = bool(fused) if fused is not None else False
     axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
     # expert leaves reduce over every replicated axis EXCEPT the one that
     # shards them — summing across ep_axis would mix different experts
@@ -111,9 +127,17 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
             return g / jnp.asarray(dp, g.dtype)
 
         grads = jax.tree_util.tree_map(reduce_grad, grads, is_ep_leaf)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
-            params, grads)
+        gl = jax.tree_util.tree_leaves(grads)
+        pl = jax.tree_util.tree_leaves(params)
+        if use_fused and all(g.dtype == p.dtype for g, p in zip(gl, pl)):
+            spec = flatten_lib.make_bucket_spec(grads, max_bucket_bytes)
+            g_flats = flatten_lib.pack_buckets(spec, grads)
+            new_params = fused_update.sgd_update_buckets(spec, params,
+                                                         g_flats, lr)
+        else:
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+                params, grads)
         return new_params, lax.pmean(loss, data_axis)
 
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)

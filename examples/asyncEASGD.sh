@@ -20,8 +20,11 @@ NUMTESTS=$(( SYNCS / TESTTIME + 1 ))
 
 common="--numNodes $NODES --port $PORT --numEpochs $EPOCHS --batchSize $BATCH \
   --numExamples $N --communicationTime $TAU --model $MODEL"
+# CONCURRENT=1 serves clients on overlapped worker threads
+# (AsyncEAServerConcurrent) instead of the reference's critical section
+SERVER_FLAGS=${CONCURRENT:+--concurrent}
 
-python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS &
+python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS $SERVER_FLAGS &
 SERVER=$!
 python easgd_tester.py $common --numTests $NUMTESTS &
 TESTER=$!

@@ -21,6 +21,10 @@ def main():
         **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
         "numSyncs": (0, "total syncs to serve (0 = numEpochs*steps/tau per node)"),
         "tester": (False, "open the test channel and expect a tester process"),
+        "concurrent": (False, "serve clients on overlapped per-client "
+                              "worker threads (AsyncEAServerConcurrent) "
+                              "instead of the reference's one-at-a-time "
+                              "critical section"),
         "syncTimeout": (0.0, "max seconds to wait for any sync request "
                              "before stopping the serve loop (0 = wait "
                              "forever, the reference's behavior — set it "
@@ -28,7 +32,8 @@ def main():
     })
     setup_platform(1, opt.tpu)
 
-    from distlearn_tpu.parallel.async_ea import AsyncEAServer
+    from distlearn_tpu.parallel.async_ea import (AsyncEAServer,
+                                                 AsyncEAServerConcurrent)
     from distlearn_tpu.utils import checkpoint as ckpt
     from distlearn_tpu.utils.logging import print_server, set_verbose
 
@@ -46,6 +51,55 @@ def main():
         // opt.communicationTime for sz in sizes)
     print_server(f"serving {opt.numNodes} clients, {num_syncs} syncs, "
                  f"tester={opt.tester}")
+
+    if opt.concurrent:
+        import time as _time
+        srv = AsyncEAServerConcurrent(opt.host, opt.port, opt.numNodes,
+                                      with_tester=opt.tester)
+        srv.init_server(params)
+        srv.start()
+        tests_pushed = last_ckpt = last_done = 0
+        last_progress = _time.time()
+        while srv.syncs_completed < num_syncs and srv.live_clients > 0:
+            if srv.drained:
+                # every client finished/died and nothing is in flight —
+                # the concurrent analogue of the serial loop's
+                # RuntimeError-from-recv_any stop
+                print_server(f"stopping after {srv.syncs_completed} syncs "
+                             "(all clients done)")
+                break
+            done = srv.syncs_completed
+            if done > last_done:            # idle timeout, not wall clock:
+                last_done = done            # progress resets the clock
+                last_progress = _time.time()
+            if opt.syncTimeout and \
+                    _time.time() - last_progress > opt.syncTimeout:
+                print_server(f"stopping after {done} syncs (no sync for "
+                             f"{opt.syncTimeout:.0f}s)")
+                break
+            if opt.tester and done // opt.testTime > tests_pushed:
+                tests_pushed += 1
+                srv.test_net()
+            if opt.save and done - last_ckpt >= opt.testTime * 2:
+                last_ckpt = done
+                ckpt.save_checkpoint(opt.save, done,
+                                     {"center": srv.current_center(params)})
+            _time.sleep(0.01)
+        params = srv.current_center(params)
+        served = srv.syncs_completed
+        if opt.tester:
+            # match the serial loop's push count exactly: one per testTime
+            # syncs plus the final eval push (the tester counts rounds)
+            while tests_pushed < served // opt.testTime:
+                tests_pushed += 1
+                srv.test_net()
+            srv.test_net()
+        if opt.save:
+            ckpt.save_checkpoint(opt.save, served, {"center": params})
+        print_server("done")
+        srv.stop()
+        srv.close()
+        return
 
     srv = AsyncEAServer(opt.host, opt.port, opt.numNodes,
                         with_tester=opt.tester)

@@ -324,3 +324,104 @@ def test_many_clients_with_abrupt_disconnects():
     np.testing.assert_allclose(srv.center[0], np.sum(sent, axis=0),
                                rtol=1e-5, atol=1e-5)
     srv.close()
+
+
+def test_concurrent_server_overlapped_syncs_accumulate_exactly():
+    """AsyncEAServerConcurrent: N clients sync concurrently through per-client
+    worker threads; the center must end at init + the sum of every pushed
+    delta (integer-valued floats -> exact regardless of apply order), and
+    every client must complete all its rounds."""
+    from distlearn_tpu.parallel.async_ea import AsyncEAServerConcurrent
+
+    port = _ports()
+    n_clients, rounds, tau, alpha = 3, 4, 1, 0.5
+    params0 = {"w": np.zeros(64, np.float32)}
+    deltas_pushed = []
+    lock = threading.Lock()
+
+    def client(node):
+        c = AsyncEAClient("127.0.0.1", port, node=node, tau=tau, alpha=alpha)
+        p = c.init_client({"w": params0["w"].copy()})
+        rng = np.random.RandomState(node)
+        for _ in range(rounds):
+            # integer-valued params make (p - c) * 0.5 exact in f32 and the
+            # center sum order-independent
+            p = {"w": p["w"] + rng.randint(-4, 5, p["w"].shape) * 2.0}
+            before = p["w"].copy()
+            p, synced = c.sync_client(p)
+            assert synced
+            with lock:
+                deltas_pushed.append((before - np.asarray(c.center[0]))
+                                     * alpha)
+        c.close()
+
+    # start clients FIRST: the server constructor blocks in accept, and the
+    # client connect() retries until the listener binds
+    threads = [threading.Thread(target=client, args=(i + 1,), daemon=True)
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=n_clients,
+                                  accept_timeout=60.0)
+    srv.init_server({"w": params0["w"].copy()})
+    srv.start()
+    deadline = 60.0
+    import time
+    t0 = time.time()
+    while srv.syncs_completed < n_clients * rounds:
+        if time.time() - t0 > deadline:
+            raise AssertionError(
+                f"only {srv.syncs_completed}/{n_clients * rounds} syncs")
+        time.sleep(0.02)
+    for t in threads:
+        t.join(timeout=20.0)
+    got = srv.current_center(params0)["w"]
+    want = params0["w"] + np.sum(deltas_pushed, axis=0)
+    np.testing.assert_array_equal(got, want)
+    srv.stop()
+    srv.close()
+
+
+def test_concurrent_server_evicts_dead_client_others_continue():
+    """A client that dies mid-handshake is evicted by ITS worker; the other
+    clients' workers keep serving."""
+    from distlearn_tpu.parallel.async_ea import AsyncEAServerConcurrent
+
+    port = _ports()
+    params0 = {"w": np.zeros(32, np.float32)}
+
+    def good_client(node, rounds):
+        c = AsyncEAClient("127.0.0.1", port, node=node, tau=1, alpha=0.5)
+        p = c.init_client({"w": params0["w"].copy()})
+        for _ in range(rounds):
+            p = {"w": p["w"] + 2.0}
+            p, _ = c.sync_client(p)
+        c.close()
+
+    def dying_client(node):
+        c = AsyncEAClient("127.0.0.1", port, node=node, tau=1, alpha=0.5)
+        c.init_client({"w": params0["w"].copy()})
+        # request entry, get admitted, then vanish mid-handshake
+        c.broadcast.send_msg({"q": "Enter?", "clientID": node})
+        c.conn.recv_msg()               # ENTER
+        c.close()                       # die before Center?
+
+    t1 = threading.Thread(target=good_client, args=(1, 3), daemon=True)
+    t2 = threading.Thread(target=dying_client, args=(2,), daemon=True)
+    t1.start(); t2.start()
+    srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=2,
+                                  accept_timeout=60.0,
+                                  handshake_timeout=2.0)
+    srv.init_server({"w": params0["w"].copy()})
+    srv.start()
+    import time
+    t0 = time.time()
+    while srv.syncs_completed < 3:
+        assert time.time() - t0 < 30.0, srv.syncs_completed
+        time.sleep(0.02)
+    t1.join(timeout=20.0)
+    t2.join(timeout=20.0)
+    assert 2 in srv.evicted
+    assert srv.syncs_completed == 3
+    srv.stop()
+    srv.close()

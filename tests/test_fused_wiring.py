@@ -129,3 +129,37 @@ def test_bucket_spec_respects_max_bytes():
     flats = flatten_lib.pack_buckets(spec, tree)
     back = flatten_lib.unpack_buckets(spec, flats)
     _leaves_equal(tree, back)
+
+
+def test_fused_lm_step_matches_unfused():
+    """The LM step's Pallas packed-bucket SGD update must reproduce the
+    per-leaf tree_map update (same mesh, same batch) — the wiring that
+    removes the ~21%-of-step-time per-leaf f32 update the dim-4096
+    profile exposed."""
+    from jax.sharding import Mesh
+    from distlearn_tpu.models.transformer import (param_specs,
+                                                  transformer_lm)
+    from distlearn_tpu.train.lm import build_lm_step
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2, 1),
+                ("data", "seq", "model"))
+    lm = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=16)
+    params, _ = lm.init(random.PRNGKey(0))
+    toks = jax.device_put(
+        np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32),
+        NamedSharding(mesh, P("data", "seq")))
+    sh = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s),
+                                param_specs(params, tp_axis="model"))
+    outs = {}
+    for fused in (False, True):
+        step = build_lm_step(lm, mesh, params, lr=0.1, fused=fused,
+                             donate=False)
+        p = jax.device_put(params, sh)
+        for _ in range(3):
+            p, loss = step(p, toks)
+        outs[fused] = (float(loss), jax.tree_util.tree_leaves(
+            jax.device_get(p)))
+    np.testing.assert_allclose(outs[False][0], outs[True][0], rtol=1e-6)
+    for a, b in zip(outs[False][1], outs[True][1]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Attribute device step time from a jax.profiler trace.
+
+Reads the Chrome-trace JSON (``*.trace.json.gz``) a ``--profile`` run
+wrote (examples/lm.py, tools/profile_resnet.py) and prints device time
+grouped by output-shape signature and fusion kind — the evidence behind
+docs/PERF.md's utilization-gap table (a measured
+matmul/attention/elementwise/update breakdown rather than an
+arithmetic-intensity argument).
+
+Works with the stdlib only: the xplane.pb route needs
+tensorboard_plugin_profile, whose generated protos clash with this
+environment's protobuf/TF versions, while the chrome trace carries the
+same per-op durations (`pid` = device, `tid` "XLA Ops" lane) plus each
+op's HLO `long_name` for shape-based classification.
+
+Usage:
+    python tools/analyze_trace.py /tmp/prof4096 [--top 25]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def find_trace(log_dir: str) -> str:
+    hits = sorted(glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
+                            recursive=True))
+    if not hits:
+        sys.exit(f"no *.trace.json.gz under {log_dir}")
+    return hits[-1]
+
+
+def load_device_ops(path: str):
+    """[(name, kind, shape_sig, dur_us)] for the device's 'XLA Ops' lane."""
+    data = json.load(gzip.open(path, "rt"))
+    ev = data["traceEvents"] if isinstance(data, dict) else data
+    device_pids = set()
+    op_lanes = {}                     # pid -> tid of the "XLA Ops" lane
+    for e in ev:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name" and "device:" in str(
+                e.get("args", {}).get("name", "")).lower() \
+                and "cpu" not in str(e["args"]["name"]).lower():
+            device_pids.add(e["pid"])
+        if e.get("name") == "thread_name" \
+                and e.get("args", {}).get("name") == "XLA Ops":
+            op_lanes[e["pid"]] = e["tid"]
+    ops = []
+    for e in ev:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        if pid not in device_pids or e.get("tid") != op_lanes.get(pid):
+            continue
+        ln = (e.get("args") or {}).get("long_name", "")
+        m = re.match(r"%\S+ = (\(?[a-z0-9]+\[[^\]]*\])", ln)
+        sig = re.sub(r"\{[^}]*\}", "", m.group(1)) if m else "?"
+        kind = e["name"].split(".")[0]
+        ops.append((e["name"], kind, sig, float(e.get("dur", 0.0))))
+    return ops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log_dir")
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    ops = load_device_ops(find_trace(args.log_dir))
+    if not ops:
+        sys.exit("no device XLA-op events in trace (profile a real step)")
+    total = sum(t for *_, t in ops)
+    by_sig = collections.Counter()
+    for _, kind, sig, t in ops:
+        by_sig[(kind, sig)] += t
+    print(f"device XLA-op time: {total/1e3:.1f} ms over the trace window "
+          f"({len(ops)} op executions)")
+    print(f"\n== top {args.top} (fusion kind, output signature) ==")
+    for (kind, sig), t in by_sig.most_common(args.top):
+        print(f"  {t/1e3:8.1f} ms {100*t/total:5.1f}%  {kind:28s} {sig}")
+
+
+if __name__ == "__main__":
+    main()

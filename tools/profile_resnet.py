@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace of the ResNet-50 fused SGD step.
+
+The LM example has ``--profile``; this gives the ResNet bench config
+(BASELINE.md stretch model) the same treatment so the utilization-gap
+analysis (docs/PERF.md) rests on measured op breakdowns for both model
+families.
+
+Usage:
+    python tools/profile_resnet.py /tmp/prof_resnet [--batch 256] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))        # repo root (run from anywhere)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log_dir")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.models.resnet import resnet50
+    from distlearn_tpu.parallel.mesh import MeshTree
+    from distlearn_tpu.train import build_sgd_step, init_train_state
+    from distlearn_tpu.utils.profiling import trace
+
+    tree = MeshTree(num_nodes=len(jax.devices()))
+    platform = jax.devices()[0].platform
+    model = resnet50(
+        compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+    ts = init_train_state(model, tree, random.PRNGKey(0), 1000)
+    step = build_sgd_step(model, tree, lr=0.1)
+    rs = np.random.RandomState(0)
+    sh = NamedSharding(tree.mesh, P("data"))
+    bx = jax.device_put(rs.randn(args.batch, 224, 224, 3)
+                        .astype(np.float32), sh)
+    by = jax.device_put(rs.randint(0, 1000, (args.batch,))
+                        .astype(np.int32), sh)
+
+    for _ in range(3):                       # compile + warmup
+        ts, loss = step(ts, bx, by)
+    jax.block_until_ready(ts.params)
+    with trace(args.log_dir):
+        for _ in range(args.steps):
+            ts, loss = step(ts, bx, by)
+        jax.block_until_ready(ts.params)
+    print(f"trace written to {args.log_dir} "
+          f"({args.steps} steps, final loss {float(loss):.4f})")
+
+
+if __name__ == "__main__":
+    main()
