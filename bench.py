@@ -599,13 +599,69 @@ def _analytic_lm_train_flops(batch, seq, dim, depth, vocab=32768):
     return 3.0 * fwd
 
 
+def bench_moe_lm(batch, seq, iters, windows, peak):
+    """Routed-MoE LM utilization on one chip (experts all-resident —
+    the ``moe_ffn_local`` path; on a pod the same model shards one
+    expert per device over the data axis with two all-to-alls).  Every
+    second block is a top-1 (Switch) mixture of 8 experts with the
+    load-balancing auxiliary loss on — the routed-dispatch einsums and
+    capacity bookkeeping are in the measured step, so this is the
+    chip-level cost of the MoE machinery."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import random
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train.lm import build_lm_step
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs[:1]).reshape(1, 1, 1),
+                ("data", "seq", "model"))
+    dim = int(os.environ.get("BENCH_MOE_DIM", "1024"))
+    depth = int(os.environ.get("BENCH_MOE_DEPTH", "8"))
+    experts = int(os.environ.get("BENCH_MOE_EXPERTS", "8"))
+    lm = transformer_lm(vocab=32768, dim=dim, depth=depth, heads=dim // 64,
+                        max_len=seq, compute_dtype=jnp.bfloat16,
+                        moe_experts=experts, moe_every=2)
+    params, _ = lm.init(random.PRNGKey(0))
+    step = build_lm_step(lm, mesh, params, lr=1e-2,
+                         moe_balance_weight=0.01)
+    tokens = jax.device_put(
+        np.random.RandomState(0).randint(0, 32768, (batch, seq))
+        .astype(np.int32),
+        NamedSharding(mesh, P("data", "seq")))
+
+    flops = step_flops(step, params, tokens)
+    state = {"p": params}
+
+    def run(n):
+        p = state["p"]
+        for _ in range(n):
+            p, loss = step(p, tokens)
+        state["p"] = p
+        state["loss"] = float(jax.device_get(loss))
+
+    med, times = timed_windows(lambda: run(iters), lambda: run(5), windows)
+    sps = iters / med
+    mfu = check_mfu("moe_lm", flops, sps, peak)
+    return {
+        "batch": batch, "seq_len": seq, "dim": dim, "depth": depth,
+        "experts": experts, "top_k": 1, "steps_per_sec": sps,
+        "tokens_per_sec": sps * batch * seq, "flops_per_step": flops,
+        "mfu": mfu, "window_times": times, "final_loss": state["loss"],
+    }
+
+
 def bench_pp_lm(batch, seq, iters, windows, peak):
     """GPipe machinery cost on the real chip: the pipeline-parallel LM step
     (train.lm.build_lm_pp_step) at S=1 (one stage — the only pipe size one
     chip can host) with M microbatches, vs the plain fused step on the
     SAME model, measured back to back.  At S=1 there is no bubble, so any
-    deficit is pure schedule machinery: the tick scan, per-microbatch
-    head, and activation slicing.  The bubble on a real pod adds the known
+    deficit is pure schedule machinery: the tick scan (unrolled here —
+    measured 1.68x over the rolled scan), per-microbatch head, and
+    activation slicing.  The bubble on a real pod adds the known
     (S-1)/(M+S-1) on top — this row bounds the REST of the PP overhead.
     MFU uses the plain step's cost_analysis flops for both (the scanned
     PP program under-reports: XLA counts one loop iteration).  Config is
@@ -656,7 +712,7 @@ def bench_pp_lm(batch, seq, iters, windows, peak):
     stacked = jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
     step = build_lm_pp_step(mesh, shared, stacked, lr=1e-2,
                             num_microbatches=M,
-                            compute_dtype=jnp.bfloat16)
+                            compute_dtype=jnp.bfloat16, unroll=True)
     tokens = jax.device_put(
         np.random.RandomState(0).randint(0, 32768, (batch, seq))
         .astype(np.int32), NamedSharding(mesh, P("data")))
@@ -840,6 +896,24 @@ def main():
             raise
         except Exception as e:  # noqa: BLE001
             print(f"[bench] transformer_lm bench failed: {e}", file=sys.stderr)
+
+    # --- routed-MoE LM utilization ------------------------------------------
+    if os.environ.get("BENCH_SKIP_MOE") != "1" and platform == "tpu":
+        try:
+            details["moe_lm"] = bench_moe_lm(
+                int(os.environ.get("BENCH_LM_BATCH", "8")),
+                int(os.environ.get("BENCH_LM_SEQ", "1024")),
+                int(os.environ.get("BENCH_LM_ITERS", "30")), 3, peak)
+            mo = details["moe_lm"]
+            print(f"[bench] moe_lm ({mo['experts']} experts, top-1) "
+                  f"batch={mo['batch']} seq={mo['seq_len']}: "
+                  f"{mo['tokens_per_sec']:.0f} tok/s"
+                  + (f", MFU={mo['mfu']:.4f}" if mo["mfu"] is not None
+                     else ""), file=sys.stderr)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] moe_lm bench failed: {e}", file=sys.stderr)
 
     # --- pipeline-parallel machinery overhead (S=1 on one chip) -------------
     if os.environ.get("BENCH_SKIP_PP") != "1" and platform == "tpu":

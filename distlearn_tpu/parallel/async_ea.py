@@ -113,6 +113,16 @@ class AsyncEAServer:
                 print_server(f"initial broadcast to a client failed: {e!r}")
                 conn.close()
 
+    def _check_delta(self, deltas: list[np.ndarray]):
+        """Reject a structurally wrong delta BEFORE any leaf is applied, so
+        the center never takes a torn update (a mismatched client config
+        becomes an eviction, not a corrupted center)."""
+        for t, d in zip(self.center, deltas):
+            if tuple(d.shape) != tuple(t.shape):
+                raise ProtocolError(
+                    f"delta leaf shape {tuple(d.shape)} != center "
+                    f"{tuple(t.shape)} — client/server model config skew")
+
     def _evict(self, cid: int, why: Exception):
         """Drop a dead/hung client: close both its channels so recv_any stops
         selecting it; remaining clients keep syncing."""
@@ -133,6 +143,33 @@ class AsyncEAServer:
     def live_clients(self) -> int:
         return self.num_nodes - len(self.evicted)
 
+    def _admit(self, idx: int, msg) -> int | None:
+        """Validate one broadcast-channel request (``Enter?`` + a sane,
+        non-evicted clientID).  Returns the client id, or ``None`` after
+        dropping the broken peer — shared by the serial serve loop and the
+        concurrent dispatcher so admission rules cannot drift."""
+        if not isinstance(msg, dict) or msg.get("q") != ENTER_Q:
+            try:
+                self.broadcast.conns[idx].close()
+            except OSError:
+                pass
+            print_server(f"dropping peer with bad request {msg!r}")
+            return None
+        try:
+            cid = int(msg.get("clientID", -1))
+        except (TypeError, ValueError):
+            cid = -1
+        if not 1 <= cid <= self.num_nodes or cid in self.evicted:
+            try:
+                self.broadcast.conns[idx].close()
+            except OSError:
+                pass
+            print_server(f"dropping peer with bad clientID "
+                         f"{msg.get('clientID')!r}")
+            return None
+        self._cid_to_broadcast[cid] = idx
+        return cid
+
     def sync_server(self, params: PyTree,
                     timeout: float | None = None) -> PyTree:
         """One full server-side sync round (ref ``syncServer``, lua :230-237):
@@ -150,22 +187,9 @@ class AsyncEAServer:
         while True:
             # serverEnterSync (lua :163-177): critical section — one client.
             idx, msg = self.broadcast.recv_any(timeout=timeout)
-            if not isinstance(msg, dict) or msg.get("q") != ENTER_Q:
-                # Garbage on the broadcast channel: that peer is broken, not
-                # the server — drop it and keep serving.
-                self.broadcast.conns[idx].close()
-                print_server(f"dropping peer with bad request {msg!r}")
+            cid = self._admit(idx, msg)
+            if cid is None:
                 continue
-            try:
-                cid = int(msg.get("clientID", -1))
-            except (TypeError, ValueError):
-                cid = -1
-            if not 1 <= cid <= self.num_nodes or cid in self.evicted:
-                self.broadcast.conns[idx].close()
-                print_server(f"dropping peer with bad clientID "
-                             f"{msg.get('clientID')!r}")
-                continue
-            self._cid_to_broadcast[cid] = idx
             self.current_client = cid
             conn = self.dedicated[cid - 1]  # 1-based ids (ref)
             try:
@@ -184,6 +208,7 @@ class AsyncEAServer:
                 _expect(conn, DELTA_Q)
                 conn.send_msg(DELTA)
                 deltas = [conn.recv_tensor() for _ in self.center]
+                self._check_delta(deltas)
                 conn.set_timeout(None)
             except (TimeoutError, ConnectionError, ProtocolError, OSError,
                     ValueError) as e:   # ValueError: undecodable JSON frame
@@ -378,24 +403,9 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # RuntimeError: every broadcast conn closed (all clients
                 # finished/evicted) — dispatch is done
                 return
-            if not isinstance(msg, dict) or msg.get("q") != ENTER_Q:
-                try:
-                    self.broadcast.conns[idx].close()
-                except OSError:
-                    pass
-                print_server(f"dropping peer with bad request {msg!r}")
+            cid = self._admit(idx, msg)
+            if cid is None:
                 continue
-            try:
-                cid = int(msg.get("clientID", -1))
-            except (TypeError, ValueError):
-                cid = -1
-            if not 1 <= cid <= self.num_nodes or cid in self.evicted:
-                try:
-                    self.broadcast.conns[idx].close()
-                except OSError:
-                    pass
-                continue
-            self._cid_to_broadcast[cid] = idx
             with self._lock:
                 self._inflight += 1     # token issued; worker will settle it
             self._queues[cid - 1].put(ENTER)
@@ -416,6 +426,9 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     _expect(conn, DELTA_Q)
                     conn.send_msg(DELTA)
                     deltas = [conn.recv_tensor() for _ in self.center]
+                    self._check_delta(deltas)   # before ANY apply: a
+                    # config-skewed client is an eviction, never a torn or
+                    # silently-dead worker (the serve loop polls drained)
                     conn.set_timeout(None)
                 except (TimeoutError, ConnectionError, ProtocolError,
                         OSError, ValueError) as e:

@@ -35,7 +35,8 @@ PyTree = Any
 
 def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
                    num_microbatches: int, axis_name: str = "pipe",
-                   consume_fn: Callable | None = None) -> jax.Array:
+                   consume_fn: Callable | None = None,
+                   unroll: bool | int = False) -> jax.Array:
     """Run ``x`` through ``S`` pipelined stages (``S`` = size of
     ``axis_name``).
 
@@ -55,6 +56,12 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
         rank (same program everywhere); only the last rank's valid ticks
         are accumulated — the rest are masked to zero, so no gradient
         flows from them.
+      unroll: forwarded to the tick ``lax.scan``.  ``True`` inlines all
+        ``T = M+S-1`` ticks so XLA fuses and overlaps across tick
+        boundaries — measured 1.68x on the one-chip GPipe bench
+        (docs/PERF.md) — at the cost of a ~T-times-larger program (long
+        compiles; this host's remote-compile helper rejects very large
+        programs, so it is off by default and recommended for small M).
 
     Returns:
       Without ``consume_fn``: ``[B, ...]`` outputs of the LAST stage,
@@ -108,7 +115,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
 
         (_, acc), _ = lax.scan(tick, (zeros_state,
                                       jnp.zeros((), jnp.float32)),
-                               jnp.arange(T))
+                               jnp.arange(T), unroll=unroll)
         return acc
 
     def tick(state, t):
@@ -116,7 +123,8 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
         nxt = lax.ppermute(out, axis_name, fwd_perm)
         return nxt, out
 
-    _, outs = lax.scan(tick, zeros_state, jnp.arange(T))   # [T, mb, ...]
+    _, outs = lax.scan(tick, zeros_state, jnp.arange(T),
+                       unroll=unroll)                      # [T, mb, ...]
 
     # The last stage's outputs at ticks S-1 .. T-1 are microbatches 0..M-1.
     valid = lax.dynamic_slice_in_dim(outs, S - 1, M, axis=0)

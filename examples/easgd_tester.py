@@ -4,6 +4,7 @@
 Blocks on the test channel; every server push it evaluates the center on the
 train and test sets, appends error rates to a JSONL log (the reference's
 optim.Logger + gnuplot plots, EASGD_tester.lua:40-47,161-165), and acks.
+Render the curves with ``python tools/plot_errors.py <log>.jsonl``.
 
 Run:  python easgd_tester.py --numNodes 2 --port 9500 --numTests 5 ...
 """

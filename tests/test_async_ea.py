@@ -425,3 +425,40 @@ def test_concurrent_server_evicts_dead_client_others_continue():
     assert srv.syncs_completed == 3
     srv.stop()
     srv.close()
+
+
+def test_server_evicts_config_skewed_client_before_apply():
+    """A client whose model config differs (wrong-shaped delta) must be
+    EVICTED with the center untouched — not crash the serve loop or
+    (concurrent path) silently kill a worker."""
+    port = _ports()
+    init = {"w": np.ones(16, np.float32)}
+
+    def skewed_client():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        # receive the 16-elem center into a 16-elem buffer, then push a
+        # WRONG-SHAPED delta by faking the handshake manually
+        c.center = [c.broadcast.recv_tensor()]
+        c.broadcast.send_msg({"q": "Enter?", "clientID": 1})
+        c.conn.recv_msg()                    # ENTER
+        c.conn.send_msg("Center?")
+        c.conn.recv_tensor()
+        c.conn.send_msg("delta?")
+        c.conn.recv_msg()                    # delta
+        c.conn.send_tensor(np.ones(8, np.float32))   # wrong shape
+        c.close()
+
+    t = threading.Thread(target=skewed_client, daemon=True)
+    t.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1,
+                        accept_timeout=60.0, handshake_timeout=5.0)
+    srv.init_server({"w": init["w"].copy()})
+    import pytest
+    with pytest.raises((TimeoutError, RuntimeError)):
+        # the skewed client is evicted; with no clients left the next
+        # admission wait times out / runs out of connections
+        srv.sync_server({"w": init["w"]}, timeout=5.0)
+    t.join(timeout=10.0)
+    assert 1 in srv.evicted
+    np.testing.assert_array_equal(srv.center[0], init["w"])  # untouched
+    srv.close()

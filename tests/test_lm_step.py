@@ -110,7 +110,8 @@ def test_lm_gradient_accumulation_matches_full():
                                    rtol=1e-5, atol=1e-6)
 
 
-def _pp_vs_sequential(depth, n_stages, num_microbatches, remat):
+def _pp_vs_sequential(depth, n_stages, num_microbatches, remat,
+                      unroll=False):
     """PP step on dp2 x pipe{n_stages} vs the plain single-mesh LM step:
     same loss, same updated params (gradient reassembly across pipe ranks
     is exact)."""
@@ -143,7 +144,7 @@ def _pp_vs_sequential(depth, n_stages, num_microbatches, remat):
     stacked_d = jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
     step_pp = build_lm_pp_step(mesh, shared, stacked, lr=0.1,
                                num_microbatches=num_microbatches,
-                               remat=remat, donate=False)
+                               remat=remat, unroll=unroll, donate=False)
     t_pp = jax.device_put(tokens, NamedSharding(mesh, P("data")))
     shared_n, stacked_n, loss_pp = step_pp(shared_d, stacked_d, t_pp)
 
@@ -169,6 +170,13 @@ def test_lm_pp_step_k_blocks_per_stage_remat():
     """depth=8 over 4 stages (k=2 blocks per stage) with per-block remat —
     the generalized GPipe path — still matches the sequential step."""
     _pp_vs_sequential(depth=8, n_stages=4, num_microbatches=4, remat=True)
+
+
+def test_lm_pp_step_unrolled_ticks_match():
+    """unroll=True (inlined tick scan, the measured-1.68x bench setting)
+    must not change the math."""
+    _pp_vs_sequential(depth=4, n_stages=2, num_microbatches=4, remat=False,
+                      unroll=True)
 
 
 def test_lm_ea_diverge_contract_converge():

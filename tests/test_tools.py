@@ -76,3 +76,29 @@ def test_cifar10_pickle_roundtrip(tmp_path):
     np.testing.assert_allclose(x[:per], ref.astype(np.float32) / 255.0)
     xt, yt, _ = load_npz(str(tmp_path / "cifar10_test.npz"))
     assert xt.shape == (per, 32, 32, 3)
+
+
+def test_plot_errors_renders_tester_jsonl(tmp_path):
+    """tools/plot_errors.py renders the tester's JSONL into an image —
+    the optim.Logger+gnuplot half of the reference's tester
+    (EASGD_tester.lua:161-165) the JSONL log replaced."""
+    import importlib.util
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    if importlib.util.find_spec("matplotlib") is None:
+        import pytest
+        pytest.skip("matplotlib not installed")
+    log = tmp_path / "tester.jsonl"
+    log.write_text("\n".join(
+        _json.dumps({"round": i, "train_error": 0.8 / i,
+                     "test_error": 0.9 / i}) for i in range(1, 4)) + "\n")
+    out = tmp_path / "curve.png"
+    import pathlib
+    tool = pathlib.Path(__file__).parent.parent / "tools" / "plot_errors.py"
+    res = subprocess.run([_sys.executable, str(tool), str(log),
+                          "-o", str(out)], capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stderr
+    assert out.exists() and out.stat().st_size > 1000

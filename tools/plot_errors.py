@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Render the tester's JSONL error log as an error-rate plot.
+
+The reference's tester drives ``optim.Logger`` + gnuplot curves
+(/root/reference/examples/EASGD_tester.lua:47,161-165); here the tester
+writes JSONL (utils.logging.MetricsLogger) and this tool renders it —
+the plotting half the JSONL replaced.
+
+Usage:
+    python tools/plot_errors.py ckpt/tester.jsonl [-o errors.png]
+
+Any numeric fields ending in ``_error``/``_err`` are plotted against
+``round`` (falling back to record order).  Requires matplotlib (present
+in this environment); exits with a clear message otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load(path: str):
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                print(f"skipping undecodable line: {line[:80]}",
+                      file=sys.stderr)
+    if not rows:
+        sys.exit(f"no records in {path}")
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output image (default: <jsonl>.png)")
+    args = ap.parse_args()
+
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        sys.exit("matplotlib is required to render plots; the JSONL "
+                 "itself is the portable artifact")
+
+    rows = load(args.jsonl)
+    keys = sorted({k for r in rows for k in r
+                   if (k.endswith("_error") or k.endswith("_err"))
+                   and isinstance(r[k], (int, float))})
+    if not keys:
+        sys.exit("no *_error/*_err numeric fields found")
+    xs = [r.get("round", i) for i, r in enumerate(rows)]
+
+    fig, ax = plt.subplots(figsize=(7, 4.2))
+    for k in keys:
+        ys = [r.get(k) for r in rows]
+        ax.plot(xs, ys, marker="o", markersize=3, linewidth=1.2,
+                label=k.replace("_", " "))
+    ax.set_xlabel("evaluation round")
+    ax.set_ylabel("error rate")
+    ax.set_ylim(bottom=0)
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    ax.set_title("EASGD tester error rates")
+    out = args.out or (args.jsonl.rsplit(".", 1)[0] + ".png")
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out} ({len(rows)} records, fields: {', '.join(keys)})")
+
+
+if __name__ == "__main__":
+    main()
