@@ -660,7 +660,7 @@ def bench_pp_lm(batch, seq, iters, windows, peak):
     chip can host) with M microbatches, vs the plain fused step on the
     SAME model, measured back to back.  At S=1 there is no bubble, so any
     deficit is pure schedule machinery: the tick scan (unrolled here —
-    measured 1.68x over the rolled scan), per-microbatch head, and
+    measured ~1.6x over the rolled scan), per-microbatch head, and
     activation slicing.  The bubble on a real pod adds the known
     (S-1)/(M+S-1) on top — this row bounds the REST of the PP overhead.
     MFU uses the plain step's cost_analysis flops for both (the scanned

@@ -58,7 +58,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
         flows from them.
       unroll: forwarded to the tick ``lax.scan``.  ``True`` inlines all
         ``T = M+S-1`` ticks so XLA fuses and overlaps across tick
-        boundaries — measured 1.68x on the one-chip GPipe bench
+        boundaries — measured ~1.6x on the one-chip GPipe bench
         (docs/PERF.md) — at the cost of a ~T-times-larger program (long
         compiles; this host's remote-compile helper rejects very large
         programs, so it is off by default and recommended for small M).

@@ -13,9 +13,12 @@ from distlearn_tpu.train.lm import (LMEAState, build_lm_ea_steps,
                                     init_lm_ea_state, stack_blocks,
                                     unstack_blocks)
 from distlearn_tpu.train.optim import (LMZeroState, OptaxTrainState,
-                                       ZeroTrainState, build_lm_zero_step,
+                                       ZeroTrainState,
+                                       build_lm_zero_mesh_step,
+                                       build_lm_zero_step,
                                        build_optax_step,
                                        build_zero_optax_step,
+                                       init_lm_zero_mesh_state,
                                        init_lm_zero_state, init_optax_state,
                                        init_zero_state)
 
@@ -29,4 +32,5 @@ __all__ = [
     "OptaxTrainState", "build_optax_step", "init_optax_state",
     "ZeroTrainState", "build_zero_optax_step", "init_zero_state",
     "LMZeroState", "build_lm_zero_step", "init_lm_zero_state",
+    "build_lm_zero_mesh_step", "init_lm_zero_mesh_state",
 ]

@@ -243,10 +243,10 @@ class LMZeroState(NamedTuple):
 
 def _lm_zero_layout(params: PyTree, n: int):
     for leaf in jax.tree_util.tree_leaves(params):
-        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+        dt = getattr(leaf, "dtype", None) or jnp.asarray(leaf).dtype
+        if not jnp.issubdtype(dt, jnp.floating):
             raise ValueError(
-                f"ZeRO master copy requires floating leaves, got "
-                f"{jnp.asarray(leaf).dtype}")
+                f"ZeRO master copy requires floating leaves, got {dt}")
     spec = flatten_lib.make_spec(params)
     total = ((spec.padded + n - 1) // n) * n
     return spec, total, total // n
@@ -280,9 +280,9 @@ def build_lm_zero_step(model: Model, tree: MeshTree, tx,
     model family where optimizer-state memory actually matters, with
     mixed-precision support the classifier variant rejects: bf16 (or
     mixed) param trees train against f32 master copies, cut N-ways across
-    the axis.  Data parallelism only (for TP-sharded leaves each device
-    already owns its slice's state; compose ZeRO with TP by sharding over
-    the data axis of a 2D mesh — future work).  From the reference's
+    the axis.  Data parallelism only on this builder; the TP/SP-composed
+    variant over a (data, seq, model) mesh is
+    :func:`build_lm_zero_mesh_step`.  From the reference's
     viewpoint this is the ``optim``-slot upgrade of lua/AllReduceSGD.lua's
     hot loop: allreduce-equivalent bandwidth, state memory / N.
     """
@@ -312,6 +312,121 @@ def build_lm_zero_step(model: Model, tree: MeshTree, tx,
     specs = LMZeroState(params=P(), master=P(axis), opt_state=P(axis))
     mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis)),
                            out_specs=(specs, P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def _local_template(params: PyTree, pspecs: PyTree, mesh) -> PyTree:
+    """ShapeDtypeStructs of each leaf's LOCAL shard under ``pspecs``."""
+    def shrink(leaf, spec):
+        shape = list(jnp.shape(leaf))
+        for i, ax in enumerate(tuple(spec)):
+            if ax is not None:
+                axes = (ax,) if isinstance(ax, str) else tuple(ax)
+                for a in axes:
+                    shape[i] //= mesh.shape[a]
+        return jax.ShapeDtypeStruct(tuple(shape),
+                                    jnp.asarray(leaf).dtype)
+    return jax.tree_util.tree_map(shrink, params, pspecs)
+
+
+def init_lm_zero_mesh_state(params, mesh, tx, data_axis: str = "data",
+                            tp_axis: str | None = "model") -> LMZeroState:
+    """ZeRO-1 state over a multi-axis mesh: the f32 master + optimizer
+    state cover each device's LOCAL (TP-sharded) parameters, cut
+    ``data``-ways across the data axis — ZeRO composed with tensor (and
+    sequence) parallelism.  ``params`` must already be placed with
+    :func:`distlearn_tpu.models.transformer.param_specs` shardings.
+    Master layout: ``[n_data, n_tp, chunk]`` sharded ``P(data, tp)`` —
+    unspecified mesh axes (e.g. seq) are replicated, so no seq argument
+    is needed here; every seq rank holds and updates the same slice.
+    """
+    from distlearn_tpu.models.transformer import param_specs
+    n = mesh.shape[data_axis]
+    _check_elementwise(tx, n)
+    pspecs = param_specs(params, tp_axis)
+    local_t = _local_template(params, pspecs, mesh)
+    spec, total, chunk = _lm_zero_layout(local_t, n)
+
+    def init(params_local):
+        flat = _pack_padded(spec, params_local, total)
+        my = lax.axis_index(data_axis)
+        mine = lax.dynamic_slice_in_dim(flat, my * chunk, chunk)
+        opt = tx.init(mine)
+        exp = lambda a: jnp.asarray(a)[None, None]      # noqa: E731
+        return (exp(mine),
+                jax.tree_util.tree_map(exp, opt))
+
+    out_spec = P(data_axis, tp_axis) if tp_axis else P(data_axis, None)
+    master, opt = jax.jit(jax.shard_map(
+        init, mesh=mesh, in_specs=(pspecs,),
+        out_specs=(out_spec,
+                   jax.tree_util.tree_map(lambda _: out_spec,
+                                          tx.init(jnp.zeros((chunk,),
+                                                            jnp.float32)))),
+        check_vma=False))(params)
+    return LMZeroState(params=params, master=master, opt_state=opt)
+
+
+def build_lm_zero_mesh_step(model: Model, mesh, params_template, tx,
+                            data_axis: str = "data",
+                            seq_axis: str | None = "seq",
+                            tp_axis: str | None = "model",
+                            moe_balance_weight: float = 0.0,
+                            donate: bool = True) -> Callable:
+    """ZeRO-1 LM step composed with tensor + sequence parallelism over a
+    ``(data, seq, model)`` mesh: ``step(st, tokens) -> (st, loss)``.
+
+    Per device: grads of the local loss share (ring attention over
+    ``seq_axis``, Megatron TP over ``tp_axis`` — the
+    :func:`build_lm_step` math), packed flat in f32; the seq-axis psum
+    runs on the packed buffer (every leaf — TP shards included — reduces
+    over seq exactly as in ``build_lm_step``), the data-axis reduction is
+    the ZeRO **reduce-scatter**, the sliced elementwise update runs
+    against the sharded f32 master, and one data-axis ``all_gather``
+    re-materializes the local params.  Optimizer-state memory: local
+    params (already /TP for the sharded leaves) further cut /data.
+    MoE/EP is not supported here (expert leaves must not reduce over
+    their own axis); use :func:`build_lm_step` for MoE models.
+    """
+    from distlearn_tpu.models.transformer import lm_loss, param_specs
+    n = mesh.shape[data_axis]
+    pspecs = param_specs(params_template, tp_axis)
+    local_t = _local_template(params_template, pspecs, mesh)
+    spec, total, chunk = _lm_zero_layout(local_t, n)
+
+    def step(st: LMZeroState, tokens):
+        params = st.params
+        loss, grads = jax.value_and_grad(
+            lambda p: lm_loss(model, p, tokens, seq_axis=seq_axis,
+                              tp_axis=tp_axis, reduce=False,
+                              moe_balance_weight=moe_balance_weight)
+            )(params)
+        loss = lax.psum(loss, seq_axis) if seq_axis else loss
+        flat = _pack_padded(spec, grads, total)
+        if seq_axis:
+            flat = lax.psum(flat, seq_axis)
+        gslice = lax.psum_scatter(flat, data_axis, scatter_dimension=0,
+                                  tiled=True) / jnp.float32(n)
+        master_local = jnp.squeeze(st.master, (0, 1))     # [chunk] f32
+        opt_local = jax.tree_util.tree_map(
+            lambda a: jnp.squeeze(a, (0, 1)), st.opt_state)
+        updates, opt_local = tx.update(gslice, opt_local, master_local)
+        master_local = master_local + updates
+        flat_new = lax.all_gather(master_local, data_axis, tiled=True)
+        new_params = flatten_lib.unpack(spec, flat_new)
+        exp = lambda a: jnp.asarray(a)[None, None]        # noqa: E731
+        return (LMZeroState(new_params, exp(master_local),
+                            jax.tree_util.tree_map(exp, opt_local)),
+                lax.pmean(loss, data_axis))
+
+    zspec = P(data_axis, tp_axis) if tp_axis else P(data_axis, None)
+    st_spec = LMZeroState(
+        params=pspecs, master=zspec,
+        opt_state=jax.tree_util.tree_map(
+            lambda _: zspec, tx.init(jnp.zeros((chunk,), jnp.float32))))
+    tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=(st_spec, tok_spec),
+                           out_specs=(st_spec, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
