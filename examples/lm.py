@@ -46,6 +46,10 @@ def main():
                                    "weight (0 disables; without it top-1 "
                                    "routing collapses onto few experts)"),
         "remat": (False, "jax.checkpoint each block (long-context memory)"),
+        "zero": (False, "train with Adam under ZeRO-1: optimizer state + "
+                        "f32 masters sharded over the data axis, composed "
+                        "with the sp/tp axes (train.build_lm_zero_mesh_step;"
+                        " dense models only)"),
         "accumSteps": (1, "gradient-accumulation microbatches per step "
                           "(memory lever; effective batch unchanged)"),
         "profile": ("", "capture a jax.profiler trace of steps 6..10 into "
@@ -62,11 +66,11 @@ def main():
         if opt.depth % opt.pp:
             raise SystemExit(f"--pp {opt.pp} needs --depth divisible by "
                              f"{opt.pp} (equal blocks per stage)")
-        if opt.accumSteps != 1 or opt.moeExperts:
+        if opt.accumSteps != 1 or opt.moeExperts or opt.zero:
             raise SystemExit("--pp does not support --accumSteps/"
-                             "--moeExperts (GPipe microbatching IS the "
-                             "accumulation lever on this path; MoE "
-                             "needs the expert axis of the non-pp step)")
+                             "--moeExperts/--zero (GPipe microbatching IS "
+                             "the accumulation lever on this path; MoE/ZeRO "
+                             "need the non-pp step)")
     n_dev = opt.dp * opt.sp * opt.tp * max(1, opt.pp)
     setup_platform(n_dev, opt.tpu)
 
@@ -128,14 +132,35 @@ def main():
             f"{devs[0].platform}; seq_impl={opt.seqImpl}"
             + (f"; {opt.moeExperts} experts" if opt.moeExperts else ""))
         ep_axis = "data" if opt.moeExperts else None
-        step = build_lm_step(lm, mesh, params, lr=opt.learningRate,
-                             ep_axis=ep_axis, accum_steps=opt.accumSteps,
-                             moe_balance_weight=(opt.moeBalanceWeight
-                                                 if opt.moeExperts else 0.0))
-        params = jax.device_put(
+        placed = jax.device_put(
             params, jax.tree_util.tree_map(
                 lambda s: NamedSharding(mesh, s),
                 param_specs(params, tp_axis="model", ep_axis=ep_axis)))
+        if opt.zero:
+            if opt.moeExperts or opt.accumSteps != 1:
+                raise SystemExit("--zero supports dense models without "
+                                 "--accumSteps/--moeExperts (expert leaves "
+                                 "must not reduce over their own axis)")
+            import optax
+
+            from distlearn_tpu.train import (build_lm_zero_mesh_step,
+                                             init_lm_zero_mesh_state)
+            if opt.learningRate > 0.01:
+                log(f"NOTE: --learningRate {opt.learningRate} is large "
+                    "for Adam; --zero usually wants ~1e-3 (large Adam "
+                    "steps diverge)")
+            tx = optax.adam(opt.learningRate)
+            step = build_lm_zero_mesh_step(lm, mesh, params, tx)
+            params = init_lm_zero_mesh_state(placed, mesh, tx)
+            log("ZeRO-1: Adam state + f32 masters sharded over the data "
+                "axis (composed with sp/tp)")
+        else:
+            step = build_lm_step(
+                lm, mesh, params, lr=opt.learningRate,
+                ep_axis=ep_axis, accum_steps=opt.accumSteps,
+                moe_balance_weight=(opt.moeBalanceWeight
+                                    if opt.moeExperts else 0.0))
+            params = placed
         tok_spec = P("data", "seq")
         if opt.moeExperts:
             moe_metrics = build_lm_moe_metrics(lm, mesh, params,
