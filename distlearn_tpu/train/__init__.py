@@ -12,8 +12,9 @@ from distlearn_tpu.train.lm import (LMEAState, build_lm_ea_steps,
                                     build_lm_pp_step, build_lm_step,
                                     init_lm_ea_state, stack_blocks,
                                     unstack_blocks)
-from distlearn_tpu.train.optim import (LMZeroState, OptaxTrainState,
-                                       ZeroTrainState,
+from distlearn_tpu.train.optim import (LMOptaxState, LMZeroState,
+                                       OptaxTrainState, ZeroTrainState,
+                                       build_lm_optax_step,
                                        build_lm_zero_mesh_step,
                                        build_lm_zero_step,
                                        build_optax_step,
@@ -33,4 +34,5 @@ __all__ = [
     "ZeroTrainState", "build_zero_optax_step", "init_zero_state",
     "LMZeroState", "build_lm_zero_step", "init_lm_zero_state",
     "build_lm_zero_mesh_step", "init_lm_zero_mesh_state",
+    "LMOptaxState", "build_lm_optax_step",
 ]

@@ -19,6 +19,48 @@ from distlearn_tpu.models.transformer import (_rmsnorm, block_apply, lm_loss,
 from distlearn_tpu.parallel.pp import pipeline_apply
 
 
+def lm_local_grads(model: Model, params, tokens, *, seq_axis, tp_axis,
+                   ep_axis=None, accum_steps: int = 1,
+                   moe_balance_weight: float = 0.0):
+    """``(local_loss_share, grads)`` of the LM objective on THIS device's
+    shard — the gradient machinery shared by every LM step builder
+    (:func:`build_lm_step`, ``optim.build_lm_optax_step``).
+
+    Differentiates the LOCAL loss share (``lm_loss(reduce=False)``): psum
+    transposes to psum under shard_map, so the global psum'd loss must
+    not sit inside the differentiated function.  ``accum_steps=k`` scans
+    k microbatches and averages — memory lever, same effective batch.
+    """
+    def local_grad(toks):
+        return jax.value_and_grad(
+            lambda p: lm_loss(model, p, toks, seq_axis=seq_axis,
+                              tp_axis=tp_axis, ep_axis=ep_axis,
+                              reduce=False,
+                              moe_balance_weight=moe_balance_weight)
+            )(params)
+
+    if accum_steps == 1:
+        return local_grad(tokens)
+    if tokens.shape[0] % accum_steps:
+        raise ValueError(
+            f"per-device batch {tokens.shape[0]} not divisible by "
+            f"accum_steps={accum_steps}")
+    micro = tokens.reshape((accum_steps, -1) + tokens.shape[1:])
+
+    def body(carry, toks):
+        acc_l, acc_g = carry
+        li, gi = local_grad(toks)
+        return (acc_l + li,
+                jax.tree_util.tree_map(jnp.add, acc_g, gi)), None
+
+    zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (acc_l, acc_g), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), zero), micro)
+    return (acc_l / jnp.float32(accum_steps),
+            jax.tree_util.tree_map(
+                lambda g: g / jnp.asarray(accum_steps, g.dtype), acc_g))
+
+
 def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                   data_axis: str = "data", seq_axis: str | None = "seq",
                   tp_axis: str | None = "model",
@@ -80,38 +122,10 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
         lambda s: ep_axis is not None and ep_axis in s, pspecs)
 
     def step(params, tokens):
-        # differentiate the LOCAL loss share (reduce=False): see lm_loss —
-        # psum transposes to psum under shard_map, so the global psum'd loss
-        # must not sit inside the differentiated function
-        def local_grad(toks):
-            return jax.value_and_grad(
-                lambda p: lm_loss(model, p, toks, seq_axis=seq_axis,
-                                  tp_axis=tp_axis, ep_axis=ep_axis,
-                                  reduce=False,
-                                  moe_balance_weight=moe_balance_weight)
-                )(params)
-
-        if accum_steps == 1:
-            local_loss, grads = local_grad(tokens)
-        else:
-            if tokens.shape[0] % accum_steps:
-                raise ValueError(
-                    f"per-device batch {tokens.shape[0]} not divisible by "
-                    f"accum_steps={accum_steps}")
-            micro = tokens.reshape((accum_steps, -1) + tokens.shape[1:])
-
-            def body(carry, toks):
-                acc_l, acc_g = carry
-                li, gi = local_grad(toks)
-                return (acc_l + li,
-                        jax.tree_util.tree_map(jnp.add, acc_g, gi)), None
-
-            zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (acc_l, acc_g), _ = lax.scan(
-                body, (jnp.zeros((), jnp.float32), zero), micro)
-            local_loss = acc_l / jnp.float32(accum_steps)
-            grads = jax.tree_util.tree_map(
-                lambda g: g / jnp.asarray(accum_steps, g.dtype), acc_g)
+        local_loss, grads = lm_local_grads(
+            model, params, tokens, seq_axis=seq_axis, tp_axis=tp_axis,
+            ep_axis=ep_axis, accum_steps=accum_steps,
+            moe_balance_weight=moe_balance_weight)
         loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
         # Sum partial grads over seq (params replicated there, each shard
         # holds part of the chain) and AVERAGE over data (the global

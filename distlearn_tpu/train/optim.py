@@ -315,6 +315,61 @@ def build_lm_zero_step(model: Model, tree: MeshTree, tx,
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+class LMOptaxState(NamedTuple):
+    """Replicated-state optax training for the LM family."""
+    params: PyTree
+    opt_state: PyTree
+
+
+def build_lm_optax_step(model: Model, mesh, tx,
+                        data_axis: str = "data",
+                        seq_axis: str | None = "seq",
+                        accum_steps: int = 1,
+                        moe_balance_weight: float = 0.0,
+                        donate: bool = True) -> Callable:
+    """Any optax optimizer on the transformer-LM family over a
+    ``(data, seq)`` mesh: ``step(st, tokens) -> (st, loss)`` with
+    ``st = LMOptaxState(params, opt_state)``, both replicated (every
+    replica applies the identical psum'd gradient, so the state stays
+    bitwise-replicated — the ``build_optax_step`` recipe on the model
+    family the reference never had).  Initialize with
+    ``LMOptaxState(params, tx.init(params))``.
+
+    Tensor-parallel or expert-sharded leaves would need sharded optimizer
+    state; pass ``tp_axis`` work to :func:`build_lm_zero_mesh_step`
+    (sharded f32 masters) instead — this builder rejects nothing because
+    it simply never shards params.  MoE models run with all experts
+    resident (``ep_axis=None``); ``moe_balance_weight`` folds the Switch
+    auxiliary loss in.  ``accum_steps`` microbatches the per-device rows
+    exactly as :func:`distlearn_tpu.train.lm.build_lm_step` does.
+    """
+    from distlearn_tpu.train.lm import lm_local_grads
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
+
+    def step(st: LMOptaxState, tokens):
+        local_loss, grads = lm_local_grads(
+            model, st.params, tokens, seq_axis=seq_axis, tp_axis=None,
+            accum_steps=accum_steps,
+            moe_balance_weight=moe_balance_weight)
+        loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
+        dp = lax.psum(1, data_axis)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axes) / jnp.asarray(dp, g.dtype), grads)
+        updates, opt_state = tx.update(grads, st.opt_state, st.params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), st.params, updates)
+        return (LMOptaxState(params, opt_state),
+                lax.pmean(loss, data_axis))
+
+    tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    spec = LMOptaxState(params=P(), opt_state=P())
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
+                           out_specs=(spec, P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
 def _local_template(params: PyTree, pspecs: PyTree, mesh) -> PyTree:
     """ShapeDtypeStructs of each leaf's LOCAL shard under ``pspecs``."""
     def shrink(leaf, spec):

@@ -27,3 +27,41 @@ def test_mnist_parity_line():
     # synthetic set is separable: 3 epochs must beat chance by a wide margin
     # (docs/PARITY.md synthetic row; probe run reached ~0.9 by epoch 3)
     assert rec["train_acc"] > 0.5, rec
+
+
+def test_bench_section_retry_semantics():
+    """run_bench_section retries ONCE on the tunnel's transient signature
+    and fails fast on deterministic errors."""
+    import sys
+    sys.path.insert(0, os.path.dirname(_EXAMPLES))  # repo root (bench.py)
+    import bench
+
+    calls = {"n": 0}
+
+    def transient_then_ok():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("read body: response body closed before "
+                               "all bytes were read")
+        return {"ok": True}
+
+    assert bench.run_bench_section("t", transient_then_ok) == {"ok": True}
+    assert calls["n"] == 2
+
+    calls["n"] = 0
+
+    def deterministic():
+        calls["n"] += 1
+        raise ValueError("RESOURCE_EXHAUSTED: out of memory")
+
+    assert bench.run_bench_section("d", deterministic) is None
+    assert calls["n"] == 1          # no pointless second 30-iter run
+
+    calls["n"] = 0
+
+    def always_transient():
+        calls["n"] += 1
+        raise RuntimeError("response body closed")
+
+    assert bench.run_bench_section("a", always_transient) is None
+    assert calls["n"] == 2

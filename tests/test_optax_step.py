@@ -312,3 +312,71 @@ def test_lm_zero_mesh_step_composes_with_tp_sp():
     assert st.master.shape[0] == 2 and st.master.shape[1] == 2
     for s in st.master.addressable_shards:
         assert s.data.shape[:2] == (1, 1)
+
+
+def test_lm_optax_step_matches_single_device_oracle():
+    """build_lm_optax_step (replicated Adam state over a dp x sp mesh)
+    must match single-device jax + optax on the same global batch, and
+    the optimizer state must stay replicated."""
+    from jax.sharding import Mesh
+    from distlearn_tpu.models.transformer import lm_loss, transformer_lm
+    from distlearn_tpu.train import LMOptaxState, build_lm_optax_step
+
+    L = 32
+    lm = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=L)
+    params, _ = lm.init(random.PRNGKey(0))
+    toks = np.random.RandomState(0).randint(0, 64, (8, L)).astype(np.int32)
+    tx = optax.adam(1e-3)
+
+    # single-device oracle (standard jax+optax loop)
+    p_ref, s_ref = params, tx.init(params)
+    for _ in range(3):
+        l_ref, g = jax.value_and_grad(
+            lambda q: lm_loss(lm, q, jnp.asarray(toks), seq_axis=None,
+                              tp_axis=None))(p_ref)
+        u, s_ref = tx.update(g, s_ref, p_ref)
+        p_ref = jax.tree_util.tree_map(lambda a, b: a + b, p_ref, u)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2, 1),
+                ("data", "seq", "model"))
+    st = LMOptaxState(params, tx.init(params))
+    step = build_lm_optax_step(lm, mesh, tx, donate=False)
+    tk = jax.device_put(toks, NamedSharding(mesh, P("data", "seq")))
+    for _ in range(3):
+        st, loss = step(st, tk)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(jax.device_get(st.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    for leaf in jax.tree_util.tree_leaves(st.opt_state):
+        if hasattr(leaf, "sharding"):
+            assert leaf.sharding.is_fully_replicated
+
+
+def test_lm_optax_step_moe_with_balance_trains():
+    """The optax LM step handles all-experts-resident MoE models with the
+    Switch balance loss folded in."""
+    from jax.sharding import Mesh
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import LMOptaxState, build_lm_optax_step
+
+    L = 16
+    lm = transformer_lm(vocab=32, dim=32, depth=2, heads=4, max_len=L,
+                        moe_experts=4, moe_every=2)
+    params, _ = lm.init(random.PRNGKey(1))
+    tx = optax.adam(3e-3)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1, 1),
+                ("data", "seq", "model"))
+    st = LMOptaxState(params, tx.init(params))
+    step = build_lm_optax_step(lm, mesh, tx,
+                               moe_balance_weight=0.01, donate=False)
+    base = np.random.RandomState(1).randint(0, 32, (1, L)).astype(np.int32)
+    tk = jax.device_put(np.tile(base, (4, 1)),
+                        NamedSharding(mesh, P("data", "seq")))
+    losses = []
+    for _ in range(20):
+        st, loss = step(st, tk)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.3, losses
+    assert np.isfinite(losses).all()
