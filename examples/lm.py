@@ -50,6 +50,9 @@ def main():
                         "f32 masters sharded over the data axis, composed "
                         "with the sp/tp axes (train.build_lm_zero_mesh_step;"
                         " dense models only)"),
+        "optimizer": ("sgd", "sgd | adam | adamw — non-sgd runs the "
+                             "replicated-state optax step "
+                             "(train.build_lm_optax_step; needs --tp 1)"),
         "accumSteps": (1, "gradient-accumulation microbatches per step "
                           "(memory lever; effective batch unchanged)"),
         "profile": ("", "capture a jax.profiler trace of steps 6..10 into "
@@ -66,11 +69,13 @@ def main():
         if opt.depth % opt.pp:
             raise SystemExit(f"--pp {opt.pp} needs --depth divisible by "
                              f"{opt.pp} (equal blocks per stage)")
-        if opt.accumSteps != 1 or opt.moeExperts or opt.zero:
+        if (opt.accumSteps != 1 or opt.moeExperts or opt.zero
+                or opt.optimizer != "sgd"):
             raise SystemExit("--pp does not support --accumSteps/"
-                             "--moeExperts/--zero (GPipe microbatching IS "
-                             "the accumulation lever on this path; MoE/ZeRO "
-                             "need the non-pp step)")
+                             "--moeExperts/--zero/--optimizer (GPipe "
+                             "microbatching IS the accumulation lever on "
+                             "this path; MoE/ZeRO/optax need the non-pp "
+                             "step)")
     n_dev = opt.dp * opt.sp * opt.tp * max(1, opt.pp)
     setup_platform(n_dev, opt.tpu)
 
@@ -137,10 +142,12 @@ def main():
                 lambda s: NamedSharding(mesh, s),
                 param_specs(params, tp_axis="model", ep_axis=ep_axis)))
         if opt.zero:
-            if opt.moeExperts or opt.accumSteps != 1:
+            if opt.moeExperts or opt.accumSteps != 1 \
+                    or opt.optimizer != "sgd":
                 raise SystemExit("--zero supports dense models without "
-                                 "--accumSteps/--moeExperts (expert leaves "
-                                 "must not reduce over their own axis)")
+                                 "--accumSteps/--moeExperts, and picks its "
+                                 "own optimizer (Adam against the sharded "
+                                 "f32 masters) — drop --optimizer")
             import optax
 
             from distlearn_tpu.train import (build_lm_zero_mesh_step,
@@ -154,6 +161,25 @@ def main():
             params = init_lm_zero_mesh_state(placed, mesh, tx)
             log("ZeRO-1: Adam state + f32 masters sharded over the data "
                 "axis (composed with sp/tp)")
+        elif opt.optimizer != "sgd":
+            if opt.tp != 1 or opt.moeExperts:
+                raise SystemExit(f"--optimizer {opt.optimizer} uses the "
+                                 "replicated-state optax step: pass --tp 1 "
+                                 "(TP needs --zero's sharded masters) and "
+                                 "no --moeExperts (expert-sharded state)")
+            import optax
+
+            from distlearn_tpu.train import (LMOptaxState,
+                                             build_lm_optax_step)
+            makers = {"adam": optax.adam, "adamw": optax.adamw}
+            if opt.optimizer not in makers:
+                raise SystemExit(f"unknown --optimizer {opt.optimizer!r} "
+                                 f"(sgd | {' | '.join(makers)})")
+            tx = makers[opt.optimizer](opt.learningRate)
+            step = build_lm_optax_step(lm, mesh, tx,
+                                       accum_steps=opt.accumSteps)
+            params = LMOptaxState(placed, tx.init(placed))
+            log(f"{opt.optimizer} via the replicated-state optax LM step")
         else:
             step = build_lm_step(
                 lm, mesh, params, lr=opt.learningRate,
