@@ -326,15 +326,18 @@ def test_many_clients_with_abrupt_disconnects():
     srv.close()
 
 
-def test_concurrent_server_overlapped_syncs_accumulate_exactly():
-    """AsyncEAServerConcurrent: N clients sync concurrently through per-client
-    worker threads; the center must end at init + the sum of every pushed
-    delta (integer-valued floats -> exact regardless of apply order), and
-    every client must complete all its rounds."""
+def _run_concurrent_accumulation(pin_device=None, n_clients=3, rounds=4):
+    """Shared driver: N clients sync concurrently through per-client worker
+    threads; the center must end at init + the sum of every pushed delta,
+    and every client must complete all its rounds.  Exactness rationale:
+    with alpha=0.5 and small integer drifts, every value is a small dyadic
+    rational (denominator up to 2^rounds) — exactly representable in f32,
+    so float addition is associative here and the sum is order-independent
+    regardless of how the concurrent applies interleave."""
     from distlearn_tpu.parallel.async_ea import AsyncEAServerConcurrent
 
     port = _ports()
-    n_clients, rounds, tau, alpha = 3, 4, 1, 0.5
+    tau, alpha = 1, 0.5
     params0 = {"w": np.zeros(64, np.float32)}
     deltas_pushed = []
     lock = threading.Lock()
@@ -362,7 +365,8 @@ def test_concurrent_server_overlapped_syncs_accumulate_exactly():
     for t in threads:
         t.start()
     srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=n_clients,
-                                  accept_timeout=60.0)
+                                  accept_timeout=60.0,
+                                  pin_device=pin_device)
     srv.init_server({"w": params0["w"].copy()})
     srv.start()
     deadline = 60.0
@@ -375,11 +379,25 @@ def test_concurrent_server_overlapped_syncs_accumulate_exactly():
         time.sleep(0.02)
     for t in threads:
         t.join(timeout=20.0)
+    if pin_device is not None:
+        assert srv._dev_center is not None      # really device-resident
     got = srv.current_center(params0)["w"]
     want = params0["w"] + np.sum(deltas_pushed, axis=0)
     np.testing.assert_array_equal(got, want)
     srv.stop()
     srv.close()
+
+
+def test_concurrent_server_overlapped_syncs_accumulate_exactly():
+    _run_concurrent_accumulation()
+
+
+def test_concurrent_server_device_pinned_center():
+    """pin_device: the center lives on a jax device with a jitted donated
+    apply; snapshots and accumulation must match the host path exactly."""
+    import jax
+    _run_concurrent_accumulation(pin_device=jax.devices()[0],
+                                 n_clients=2, rounds=3)
 
 
 def test_concurrent_server_evicts_dead_client_others_continue():
