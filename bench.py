@@ -143,37 +143,57 @@ def timed_windows(run_window, warmup_window, windows: int):
     return statistics.median(times), times
 
 
+def _cifar_model_and_tree():
+    """(tree, model) with the bench's dtype policy (bf16 compute on TPU) —
+    ONE place, so every CIFAR-based row benches the same model."""
+    import jax
+    import jax.numpy as jnp
+
+    from distlearn_tpu.models import cifar_convnet
+    from distlearn_tpu.parallel.mesh import MeshTree
+
+    tree = MeshTree(num_nodes=len(jax.devices()))
+    platform = jax.devices()[0].platform
+    model = cifar_convnet(
+        compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+    return tree, model
+
+
+def _stacked_cifar_batches(tree, batch: int, k: int):
+    """K distinct synthetic batches stacked on a leading step axis, placed
+    for the scanned trainers (spec ``P(None, data)``)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.data import synthetic_cifar10
+
+    xs, ys = [], []
+    for i in range(k):
+        x, y, _ = synthetic_cifar10(batch, seed=i)
+        xs.append(x); ys.append(y)
+    sh = NamedSharding(tree.mesh, P(None, "data"))
+    return jax.device_put(np.stack(xs), sh), jax.device_put(np.stack(ys), sh)
+
+
 def _build_cifar(batch: int, fused=None, data=None, scan_k: int = 0):
     """``scan_k=0``: the per-call step (one host dispatch per step).
     ``scan_k=K``: the scanned step (K chained steps per dispatch,
     ``train.build_sgd_scan_step``) with K distinct stacked batches."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
     from jax import random
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from distlearn_tpu.data import synthetic_cifar10
-    from distlearn_tpu.models import cifar_convnet
-    from distlearn_tpu.parallel.mesh import MeshTree
     from distlearn_tpu.train import (build_sgd_scan_step, build_sgd_step,
                                      init_train_state)
 
-    n_dev = len(jax.devices())
-    tree = MeshTree(num_nodes=n_dev)
-    platform = jax.devices()[0].platform
-    model = cifar_convnet(
-        compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+    tree, model = _cifar_model_and_tree()
+    n_dev = tree.num_nodes
     ts = init_train_state(model, tree, random.PRNGKey(0), 10)
     if scan_k:
         step = build_sgd_scan_step(model, tree, lr=0.1, fused=fused)
-        xs, ys = [], []
-        for i in range(scan_k):
-            x, y, _ = synthetic_cifar10(batch, seed=i)
-            xs.append(x); ys.append(y)
-        sh = NamedSharding(tree.mesh, P(None, "data"))
-        bx = jax.device_put(np.stack(xs), sh)
-        by = jax.device_put(np.stack(ys), sh)
+        bx, by = _stacked_cifar_batches(tree, batch, scan_k)
     else:
         step = build_sgd_step(model, tree, lr=0.1, fused=fused)
         if data is not None:
@@ -625,31 +645,14 @@ def bench_easgd_cycle(batch, tau, iters, windows):
     elastic round per dispatch).  Reported per LOCAL step so it is
     directly comparable to the AllReduceSGD headline: EASGD's point is
     that τ−1 of every τ steps skip the gradient collective."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
     from jax import random
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from distlearn_tpu.data import synthetic_cifar10
-    from distlearn_tpu.models import cifar_convnet
-    from distlearn_tpu.parallel.mesh import MeshTree
     from distlearn_tpu.train import build_ea_cycle, init_ea_state
 
-    n_dev = len(jax.devices())
-    tree = MeshTree(num_nodes=n_dev)
-    platform = jax.devices()[0].platform
-    model = cifar_convnet(
-        compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+    tree, model = _cifar_model_and_tree()
     ts = init_ea_state(model, tree, random.PRNGKey(0), 10)
     cycle = build_ea_cycle(model, tree, lr=0.1, alpha=0.2)
-    xs, ys = [], []
-    for i in range(tau):
-        x, y, _ = synthetic_cifar10(batch, seed=i)
-        xs.append(x); ys.append(y)
-    sh = NamedSharding(tree.mesh, P(None, "data"))
-    bx = jax.device_put(np.stack(xs), sh)
-    by = jax.device_put(np.stack(ys), sh)
+    bx, by = _stacked_cifar_batches(tree, batch, tau)
 
     # No MFU here: cost_analysis on the scanned cycle reports one loop
     # iteration's flops, so steps/s is the comparable, defensible number
@@ -660,7 +663,7 @@ def bench_easgd_cycle(batch, tau, iters, windows):
         "batch": batch, "tau": tau, "steps_per_sec": sps,
         "images_per_sec": sps * batch,
         "cycles_per_sec": sps / tau, "window_times": times,
-        "final_loss": loss, "devices": n_dev,
+        "final_loss": loss, "devices": tree.num_nodes,
     }
 
 

@@ -380,3 +380,54 @@ def test_lm_optax_step_moe_with_balance_trains():
         losses.append(float(loss))
     assert losses[-1] < losses[0] - 0.3, losses
     assert np.isfinite(losses).all()
+
+
+def test_lm_zero_state_checkpoint_roundtrip_resumes_training(tmp_path):
+    """Resume ZeRO-1 LM training from a sharded checkpoint: save the
+    LMZeroState (params replicated, master + Adam state sharded over the
+    data axis), restore, and verify the resumed trajectory matches an
+    uninterrupted run exactly."""
+    from jax.sharding import NamedSharding
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import (LMZeroState, build_lm_zero_step,
+                                     init_lm_zero_state)
+    from distlearn_tpu.utils import checkpoint as ckpt
+
+    tree = MeshTree(num_nodes=4)
+    lm = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=16)
+    params, _ = lm.init(random.PRNGKey(0))
+    tx = optax.adam(1e-3)
+    st = init_lm_zero_state(params, tree, tx)
+    step = build_lm_zero_step(lm, tree, tx, donate=False)
+    toks = jax.device_put(
+        np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32),
+        NamedSharding(tree.mesh, P("data")))
+
+    for _ in range(2):
+        st, _ = step(st, toks)
+    ckpt.save_sharded_checkpoint(str(tmp_path), 2, st._asdict())
+    # uninterrupted reference: two more steps
+    ref = st
+    for _ in range(2):
+        ref, ref_loss = step(ref, toks)
+
+    # restore into a freshly-initialized state (as a resume would)
+    st2 = init_lm_zero_state(params, tree, tx)
+    restored, meta = ckpt.restore_sharded_checkpoint(str(tmp_path),
+                                                     st2._asdict())
+    # re-place onto the mesh with the ZeRO shardings
+    st2 = LMZeroState(
+        params=jax.device_put(restored["params"],
+                              NamedSharding(tree.mesh, P())),
+        master=jax.device_put(restored["master"],
+                              NamedSharding(tree.mesh, P("data"))),
+        opt_state=jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(tree.mesh,
+                                                      P("data"))),
+            restored["opt_state"]))
+    for _ in range(2):
+        st2, loss2 = step(st2, toks)
+    np.testing.assert_allclose(float(loss2), float(ref_loss), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(st2.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
