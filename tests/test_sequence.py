@@ -180,3 +180,32 @@ def test_flash_env_fallback_on_unsupported(monkeypatch):
     out = local_attention(q, k, v, causal=True)        # flash=None -> env
     ref = local_attention(q, k, v, causal=True, flash=False)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_bf16_attention_matches_f32_reference():
+    """bf16 operands feed the matmuls natively with f32 accumulation
+    (softmax stats stay f32): both the local and the ring path must stay
+    within bf16 rounding of the f32 oracle, and ring must match local
+    under the same dtype."""
+    q, k, v = _qkv(7)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = np.asarray(local_attention(q, k, v, causal=True))
+
+    out_local = local_attention(qb, kb, vb, causal=True)
+    assert out_local.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_local, np.float32), ref,
+                               rtol=0.05, atol=0.02)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    ring = jax.jit(jax.shard_map(
+        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    out_ring = ring(qb, kb, vb)
+    assert out_ring.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out_ring, np.float32), ref,
+                               rtol=0.05, atol=0.02)
+    # ring vs local at the SAME dtype: much tighter (same rounding regime)
+    np.testing.assert_allclose(np.asarray(out_ring, np.float32),
+                               np.asarray(out_local, np.float32),
+                               rtol=0.02, atol=0.01)
