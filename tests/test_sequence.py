@@ -19,17 +19,21 @@ def _qkv(seed=0):
     return mk(), mk(), mk()
 
 
+def _ring(mesh, causal):
+    """Jitted sharded ring-attention wrapper (shared by the ring tests)."""
+    return jax.jit(jax.shard_map(
+        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=causal),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_local(causal):
     q, k, v = _qkv()
     devs = jax.devices()[:8]
     mesh = Mesh(np.array(devs), ("seq",))
 
-    ring = jax.jit(jax.shard_map(
-        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=causal),
-        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
-        out_specs=P(None, "seq"), check_vma=False))
-    out = ring(q, k, v)
+    out = _ring(mesh, causal)(q, k, v)
     ref = local_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
@@ -197,11 +201,7 @@ def test_bf16_attention_matches_f32_reference():
                                rtol=0.05, atol=0.02)
 
     mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
-    ring = jax.jit(jax.shard_map(
-        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=True),
-        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
-        out_specs=P(None, "seq"), check_vma=False))
-    out_ring = ring(qb, kb, vb)
+    out_ring = _ring(mesh, causal=True)(qb, kb, vb)
     assert out_ring.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(out_ring, np.float32), ref,
                                rtol=0.05, atol=0.02)
