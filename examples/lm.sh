@@ -1,8 +1,10 @@
 #!/bin/bash
 # Long-context LM on an 8-device virtual mesh: dp2 x sp2(ring attn) x tp2,
-# a 4-expert MoE variant (experts sharded over the data axis), and a
-# dp2 x pipe4 GPipe pipeline (one block per stage).
+# a 4-expert MoE variant with the Switch balance loss (experts sharded
+# over the data axis), a dp2 x pipe4 GPipe pipeline (2 blocks per stage,
+# remat), and ZeRO-1 Adam with sharded f32 masters composed with sp/tp.
 cd "$(dirname "$0")"
 python lm.py --dp 2 --sp 2 --tp 2 "$@"
-python lm.py --dp 4 --sp 2 --tp 1 --moeExperts 4 "$@"
-python lm.py --dp 2 --sp 1 --tp 1 --pp 4 --depth 4 "$@"
+python lm.py --dp 4 --sp 2 --tp 1 --moeExperts 4 --moeBalanceWeight 0.01 "$@"
+python lm.py --dp 2 --sp 1 --tp 1 --pp 4 --depth 8 --remat "$@"
+python lm.py --dp 2 --sp 2 --tp 2 --zero --learningRate 0.003 "$@"

@@ -65,12 +65,8 @@ def test_single_device_degenerate():
     """axis size 1: ring attention == local attention exactly."""
     q, k, v = _qkv(2)
     mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
-    ring = jax.jit(jax.shard_map(
-        lambda qq, kk, vv: ring_attention(qq, kk, vv, "seq", causal=True),
-        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
-        out_specs=P(None, "seq"), check_vma=False))
     np.testing.assert_allclose(
-        np.asarray(ring(q, k, v)),
+        np.asarray(_ring(mesh, causal=True)(q, k, v)),
         np.asarray(local_attention(q, k, v, causal=True)),
         rtol=1e-5, atol=1e-6)
 
