@@ -537,29 +537,20 @@ def bench_resnet50(batch: int, iters: int, windows: int, peak):
 
 
 def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
-                         peak, flash: bool = False, remat: bool = False):
+                         peak, attn: str | None = None,
+                         remat: bool | str = False):
     """Long-context transformer LM utilization bench: the fused LM train
     step (next-token loss, full backward, SGD) on one chip, bf16 compute.
     On a pod the same step shards over (data, seq, model) axes — see
     distlearn_tpu.train.lm; this measures the per-chip compute story.
-    ``flash=True`` switches to the Pallas flash-attention kernel (the
-    long-context path: no O(L^2) score buffer).  The env flag is read at
-    trace time, so set it before building the step and restore after."""
-    prev_flash = os.environ.get("DISTLEARN_TPU_FLASH")
-    if flash:
-        os.environ["DISTLEARN_TPU_FLASH"] = "1"
-    try:
-        return _bench_transformer_lm(batch, seq, iters, windows, peak, flash,
-                                     remat)
-    finally:
-        if flash:
-            if prev_flash is None:
-                os.environ.pop("DISTLEARN_TPU_FLASH", None)
-            else:
-                os.environ["DISTLEARN_TPU_FLASH"] = prev_flash
+    ``attn`` picks the attention kernel ("xla"/"flash"/"chunked" — see
+    distlearn_tpu.parallel.sequence.local_attention); ``remat`` is the
+    transformer's mode (False / "full" / "mlp")."""
+    return _bench_transformer_lm(batch, seq, iters, windows, peak, attn,
+                                 remat)
 
 
-def _bench_transformer_lm(batch, seq, iters, windows, peak, flash, remat):
+def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -578,7 +569,8 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, flash, remat):
         raise ValueError(f"BENCH_LM_DIM must be a multiple of 64 "
                          f"(64-dim heads), got {dim}")
     lm = transformer_lm(vocab=32768, dim=dim, depth=depth, heads=dim // 64,
-                        max_len=seq, compute_dtype=jnp.bfloat16, remat=remat)
+                        max_len=seq, compute_dtype=jnp.bfloat16, remat=remat,
+                        attn_impl=attn)
     params, _ = lm.init(random.PRNGKey(0))
     step = build_lm_step(lm, mesh, params, lr=1e-2)
     tokens = jax.device_put(
@@ -596,7 +588,8 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, flash, remat):
     if remat and flops:
         lm_nr = transformer_lm(vocab=32768, dim=dim, depth=depth,
                                heads=dim // 64, max_len=seq,
-                               compute_dtype=jnp.bfloat16, remat=False)
+                               compute_dtype=jnp.bfloat16, remat=False,
+                               attn_impl=attn)
         step_nr = build_lm_step(lm_nr, mesh, params, lr=1e-2, donate=False)
         # None (not the remat figure) when the no-remat program cannot be
         # lowered here — reporting HFU as MFU would overstate utilization;
@@ -617,7 +610,7 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, flash, remat):
     mfu = check_mfu("transformer_lm", flops_model, sps, peak)
     return {
         "batch": batch, "seq_len": seq, "dim": dim, "depth": depth,
-        "flash": flash, "remat": remat, "steps_per_sec": sps,
+        "attn": attn, "remat": remat, "steps_per_sec": sps,
         "tokens_per_sec": sps * batch * seq, "flops_per_step": flops_model,
         "hw_flops_per_step": flops, "mfu": mfu,
         "hfu": hfu if remat else None,
@@ -1000,7 +993,7 @@ def main():
                   "step (bubble excluded; real pods add (S-1)/(M+S-1))",
                   file=sys.stderr)
 
-    # --- long-context LM (flash attention, no O(L^2) buffer) ----------------
+    # --- long-context LM (chunked causal attention + selective remat) -------
     if os.environ.get("BENCH_SKIP_LM_LONG") != "1" and platform == "tpu":
         # 16384 is absent: the attached tunnel's remote-compile helper
         # dies (HTTP 500) on that program; the recipe itself is
@@ -1015,17 +1008,25 @@ def main():
             cfgs = os.environ.get("BENCH_LM_LONG_CFGS",
                                   "1x4096,1x8192,4x4096")
         lci = int(os.environ.get("BENCH_LM_LONG_ITERS", "15"))
+        # same dim/depth _bench_transformer_lm will parse (and validate)
+        lm_dim = int(os.environ.get("BENCH_LM_DIM", "1024"))
+        lm_depth = int(os.environ.get("BENCH_LM_DEPTH", "8"))
         rows = []
         for cfg in cfgs.split(","):
             lcb, lcs = (int(v) for v in cfg.strip().split("x"))
-            # flash (no O(L^2) buffer) + remat (recompute activations):
-            # the long-context memory recipe — without them even the
-            # 4096 config does not fit the chip's HBM.  MFU uses model
-            # flops (no-remat program); HFU counts the recompute.
+            # Long-context recipe (r4): CHUNKED causal attention (masked
+            # half of the scores never computed, softmax weights saved so
+            # backward re-runs no exp — measured faster than both the
+            # naive path and the Pallas flash kernel on v5e, which is
+            # exp/VPU-bound at this shape) + selective remat where the
+            # saved f32 weights fit HBM, full remat otherwise.  MFU uses
+            # model flops (no-remat program); HFU counts the recompute.
+            w_bytes = lcb * (lm_dim // 64) * lcs * lcs // 2 * 4 * lm_depth
+            remat_mode = "mlp" if w_bytes < 9e9 else "full"
             row = run_bench_section(
                 f"lm_long {cfg}",
-                lambda lcb=lcb, lcs=lcs: bench_transformer_lm(
-                    lcb, lcs, lci, 3, peak, flash=True, remat=True))
+                lambda lcb=lcb, lcs=lcs, rm=remat_mode: bench_transformer_lm(
+                    lcb, lcs, lci, 3, peak, attn="chunked", remat=rm))
             if row:
                 rows.append(row)
         # Configs whose no-remat program the compile helper rejects have
@@ -1046,7 +1047,8 @@ def main():
                                          r["steps_per_sec"], peak)
                     r["mfu_basis"] = "analytic_calibrated"
         for r in rows:
-            print(f"[bench] lm_long (flash+remat) batch={r['batch']} "
+            print(f"[bench] lm_long ({r['attn']}+remat={r['remat']}) "
+                  f"batch={r['batch']} "
                   f"seq={r['seq_len']}: {r['tokens_per_sec']:.0f} tok/s"
                   + (f", MFU={r['mfu']:.4f}" if r["mfu"] is not None else "")
                   + ("(analytic)" if r.get("mfu_basis") else "")

@@ -47,21 +47,14 @@ def _rmsnorm(params, x, eps=1e-6):
     return y * params["scale"].astype(x.dtype)
 
 
-def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
-                seq_axis: str | None = None, tp_axis: str | None = None,
-                ep_axis: str | None = None,
-                moe_capacity_factor: float = 1.25, moe_top_k: int = 1,
-                return_moe_aux: bool = False):
-    """One transformer block (pre-norm attention + FFN/MoE residuals) on a
-    LOCAL param shard — the single source of truth for the block math,
-    shared by :func:`transformer_lm`'s apply and the pipeline-parallel
-    stage fn (distlearn_tpu.train.lm.build_lm_pp_step).  ``cd`` is the
-    compute dtype; axes as in :func:`transformer_lm`.
-
-    ``return_moe_aux=True`` (MoE blocks only) returns ``(x, aux)`` with
-    the routing-health dict from :func:`distlearn_tpu.parallel.ep
-    .route_topk` (balance loss + dropped fraction) — an explicit output,
-    not a side channel, so it survives ``jax.checkpoint``."""
+def attn_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
+               seq_axis: str | None = None, tp_axis: str | None = None,
+               attn_impl: str | None = None):
+    """Attention half of a transformer block (pre-norm attention residual)
+    on a LOCAL param shard — split out of :func:`block_apply` so the
+    selective-remat mode can checkpoint the FFN half alone (saving the
+    attention output and the flash kernel's softmax residuals instead of
+    re-running the attention forward in the backward pass)."""
     h = _rmsnorm(blk["ln1"], x)
     if tp_axis is not None:   # enter column-parallel region ("f")
         h = tp_enter(h, tp_axis)
@@ -69,14 +62,20 @@ def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
     k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
     v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
     if seq_axis is not None:
-        att = seq_attn(q, k, v, seq_axis, causal=True)
+        att = seq_attn(q, k, v, seq_axis, causal=True, impl=attn_impl)
     else:
-        att = local_attention(q, k, v, causal=True)
+        att = local_attention(q, k, v, causal=True, impl=attn_impl)
     proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))
     if tp_axis is not None:   # heads were sharded: reduce ("g")
         proj = tp_reduce(proj, tp_axis)
-    x = x + proj
+    return x + proj
 
+
+def ffn_apply(blk: PyTree, x: jax.Array, cd, *, tp_axis: str | None = None,
+              ep_axis: str | None = None,
+              moe_capacity_factor: float = 1.25, moe_top_k: int = 1,
+              return_moe_aux: bool = False):
+    """FFN/MoE half of a transformer block (see :func:`attn_apply`)."""
     h = _rmsnorm(blk["ln2"], x)
     if "router" in blk:       # routed MoE FFN (parallel/ep.py)
         from distlearn_tpu.parallel.ep import moe_ffn, moe_ffn_local
@@ -121,10 +120,34 @@ def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
     return x + h + blk["b2"].astype(cd)
 
 
+def block_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
+                seq_axis: str | None = None, tp_axis: str | None = None,
+                ep_axis: str | None = None,
+                moe_capacity_factor: float = 1.25, moe_top_k: int = 1,
+                return_moe_aux: bool = False,
+                attn_impl: str | None = None):
+    """One transformer block (pre-norm attention + FFN/MoE residuals) on a
+    LOCAL param shard — the single source of truth for the block math,
+    shared by :func:`transformer_lm`'s apply and the pipeline-parallel
+    stage fn (distlearn_tpu.train.lm.build_lm_pp_step).  ``cd`` is the
+    compute dtype; axes as in :func:`transformer_lm`.
+
+    ``return_moe_aux=True`` (MoE blocks only) returns ``(x, aux)`` with
+    the routing-health dict from :func:`distlearn_tpu.parallel.ep
+    .route_topk` (balance loss + dropped fraction) — an explicit output,
+    not a side channel, so it survives ``jax.checkpoint``."""
+    x = attn_apply(blk, x, cd, seq_attn=seq_attn, seq_axis=seq_axis,
+                   tp_axis=tp_axis, attn_impl=attn_impl)
+    return ffn_apply(blk, x, cd, tp_axis=tp_axis, ep_axis=ep_axis,
+                     moe_capacity_factor=moe_capacity_factor,
+                     moe_top_k=moe_top_k, return_moe_aux=return_moe_aux)
+
+
 def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
                    dtype=jnp.float32, compute_dtype=None,
                    seq_impl: str = "ring", remat: bool = False,
+                   attn_impl: str | None = None,
                    moe_experts: int = 0, moe_every: int = 2,
                    moe_capacity_factor: float = 1.25,
                    moe_top_k: int = 1) -> Model:
@@ -136,10 +159,15 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     picks the sequence-parallel attention: ``"ring"`` (neighbor-hop K/V
     rotation, unbounded L) or ``"alltoall"`` (Ulysses head-scatter — needs
     heads divisible by the seq axis and the full score block in memory).
-    ``remat=True`` wraps each block in ``jax.checkpoint``: activations are
-    recomputed in the backward pass instead of saved — HBM drops from
-    O(depth * L * dim) to O(L * dim) at ~1/3 extra FLOPs, the standard
-    trade for long-context/deep configs.
+    ``remat=True`` (= ``"full"``) wraps each block in ``jax.checkpoint``:
+    activations are recomputed in the backward pass instead of saved — HBM
+    drops from O(depth * L * dim) to O(L * dim) at ~1/3 extra FLOPs, the
+    standard trade for long-context/deep configs.  ``remat="mlp"`` is the
+    selective middle ground (Megatron-style selective activation
+    recomputation): only the FFN half of each block is checkpointed, so
+    the attention output AND the flash kernel's softmax residuals stay
+    saved — the backward pass never re-runs the attention forward, at the
+    cost of keeping O(L * dim) attention activations per block live.
 
     ``moe_experts=E`` makes every ``moe_every``-th block's FFN a routed
     top-``moe_top_k`` mixture of ``E`` experts (parallel/ep.py; k=1 is
@@ -160,6 +188,11 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     if seq_impl not in ("ring", "alltoall"):
         raise ValueError(f"seq_impl must be 'ring' or 'alltoall', "
                          f"got {seq_impl!r}")
+    if remat not in (False, True, "full", "mlp"):
+        raise ValueError(f"remat must be False, True/'full', or 'mlp', "
+                         f"got {remat!r}")
+    if remat is True:
+        remat = "full"
     if moe_experts < 0 or (moe_experts > 0 and moe_every < 1):
         raise ValueError(f"moe_experts must be >= 0 and moe_every >= 1, "
                          f"got {moe_experts}/{moe_every}")
@@ -227,14 +260,32 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                                          ).astype(cd)[None]
 
         def make_block(is_moe):
+            if remat == "mlp":
+                # selective: attention residuals saved, FFN recomputed
+                def ffn(blk, x):
+                    return ffn_apply(blk, x, cd, tp_axis=tp_axis,
+                                     ep_axis=ep_axis,
+                                     moe_capacity_factor=moe_capacity_factor,
+                                     moe_top_k=moe_top_k,
+                                     return_moe_aux=is_moe)
+                ffn_ckpt = jax.checkpoint(ffn)
+
+                def block(blk, x):
+                    x = attn_apply(blk, x, cd, seq_attn=seq_attn,
+                                   seq_axis=seq_axis, tp_axis=tp_axis,
+                                   attn_impl=attn_impl)
+                    return ffn_ckpt(blk, x)
+                return block
+
             def block(blk, x):
                 return block_apply(blk, x, cd, seq_attn=seq_attn,
                                    seq_axis=seq_axis, tp_axis=tp_axis,
                                    ep_axis=ep_axis,
                                    moe_capacity_factor=moe_capacity_factor,
                                    moe_top_k=moe_top_k,
-                                   return_moe_aux=is_moe)
-            return jax.checkpoint(block) if remat else block
+                                   return_moe_aux=is_moe,
+                                   attn_impl=attn_impl)
+            return jax.checkpoint(block) if remat == "full" else block
 
         # ONE wrapper per block kind, reused across the depth loop: a fresh
         # jax.checkpoint closure per block stops XLA deduplicating the remat

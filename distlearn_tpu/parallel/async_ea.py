@@ -116,12 +116,18 @@ class AsyncEAServer:
     def _check_delta(self, deltas: list[np.ndarray]):
         """Reject a structurally wrong delta BEFORE any leaf is applied, so
         the center never takes a torn update (a mismatched client config
-        becomes an eviction, not a corrupted center)."""
+        becomes an eviction, not a corrupted center).  Dtype skew is config
+        skew too: an int or f64 delta of the right shape must not be
+        silently cast into the center (ADVICE r3)."""
         for t, d in zip(self.center, deltas):
             if tuple(d.shape) != tuple(t.shape):
                 raise ProtocolError(
                     f"delta leaf shape {tuple(d.shape)} != center "
                     f"{tuple(t.shape)} — client/server model config skew")
+            if d.dtype != t.dtype:
+                raise ProtocolError(
+                    f"delta leaf dtype {d.dtype} != center {t.dtype} — "
+                    "client/server model config skew")
 
     def _evict(self, cid: int, why: Exception):
         """Drop a dead/hung client: close both its channels so recv_any stops
@@ -215,7 +221,7 @@ class AsyncEAServer:
                 self._evict(cid, e)
                 continue
             for t, delta in zip(self.center, deltas):
-                t += delta.astype(t.dtype)
+                t += delta          # dtypes equal (checked) — no astype copy
             print_server(f"received delta from client #{self.current_client}")
             return _rebuild(params, [t.copy() for t in self.center])
 
@@ -290,6 +296,11 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         import queue
         import threading
         self._lock = threading.Lock()
+        # serializes APPLIERS (the center += delta semantics stay ordered)
+        # separately from the pointer lock, so snapshot readers never wait
+        # behind an O(P) apply — they grab the current immutable center
+        # list under self._lock in O(1)
+        self._apply_lock = threading.Lock()
         self._queues = [queue.Queue() for _ in range(num_nodes)]
         self._threads: list = []
         self._stop = threading.Event()
@@ -301,10 +312,22 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         self._dev_apply = None
 
     # -- center storage ------------------------------------------------------
+    #
+    # Host path: the center is an IMMUTABLE published version — every apply
+    # builds fresh leaves (one fused ``t + d`` pass, no astype copy) and
+    # swaps the list pointer under the lock.  Snapshots are therefore a
+    # pointer grab, not the O(P) memcpy-under-lock the r3 profile showed
+    # dominating 100 MB-scale syncs; workers stream straight from the
+    # frozen arrays.  Published leaves are marked read-only so a caller
+    # mutating ``current_center``'s result fails loudly instead of
+    # corrupting what concurrent workers are streaming.
     def init_server(self, params: PyTree):
         super().init_server(params)
         if self._device is not None:
             self._pin()
+        else:
+            for t in self.center:
+                t.flags.writeable = False
 
     def _pin(self):
         """Move the center to the device; build the donated fused apply."""
@@ -321,18 +344,23 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             if self._dev_center is not None:
                 return [np.asarray(jax.device_get(t))
                         for t in self._dev_center]
-            return [t.copy() for t in self.center]
+            return self.center      # immutable published version: no copy
 
     def _apply_delta(self, deltas: list[np.ndarray]):
-        with self._lock:
-            if self._dev_center is not None:
+        if self._dev_center is not None:
+            with self._lock:
                 self._dev_center = self._dev_apply(
                     self._dev_center,
                     [jax.device_put(d, self._device) for d in deltas])
-            else:
-                for t, d in zip(self.center, deltas):
-                    t += d.astype(t.dtype)
-            self._sync_count += 1
+                self._sync_count += 1
+            return
+        with self._apply_lock:      # appliers serialize; readers do not wait
+            new = [t + d for t, d in zip(self.center, deltas)]
+            for t in new:
+                t.flags.writeable = False
+            with self._lock:
+                self.center = new
+                self._sync_count += 1
 
     @property
     def syncs_completed(self) -> int:
@@ -367,6 +395,24 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             return False
         return super().test_net(tensors if tensors is not None
                                 else self._snapshot())
+
+    def _evict(self, cid: int, why: Exception):
+        """Concurrent eviction: mark + drain the client's token queue under
+        the SAME lock the dispatcher enqueues under, so no token can land
+        after the drain — otherwise a token issued in the
+        admit-then-enqueue window would never be consumed, ``_inflight``
+        would leak, and ``drained`` could never become true (ADVICE r3
+        TOCTOU)."""
+        import queue as _q
+        with self._lock:
+            super()._evict(cid, why)
+            while True:
+                try:
+                    token = self._queues[cid - 1].get_nowait()
+                except _q.Empty:
+                    break
+                if token is not None:     # the None stop sentinel never
+                    self._inflight -= 1   # incremented _inflight
 
     # -- threads -------------------------------------------------------------
     def start(self):
@@ -407,11 +453,18 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             if cid is None:
                 continue
             with self._lock:
+                # re-check under the lock: the client's worker may have
+                # evicted it (and drained its queue) since _admit's
+                # unlocked check — enqueueing now would leak the token
+                if cid in self.evicted:
+                    continue
                 self._inflight += 1     # token issued; worker will settle it
-            self._queues[cid - 1].put(ENTER)
+                self._queues[cid - 1].put(ENTER)
 
     def _worker(self, cid: int):
         conn = self.dedicated[cid - 1]
+        bufs = None     # reusable delta recv buffers (host path): no 100 MB
+        #                 allocation + page-fault pass per sync
         while not self._stop.is_set():
             token = self._queues[cid - 1].get()
             if token is None:
@@ -425,24 +478,21 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                         conn.send_tensor(t)
                     _expect(conn, DELTA_Q)
                     conn.send_msg(DELTA)
-                    deltas = [conn.recv_tensor() for _ in self.center]
+                    if self._dev_center is None:
+                        if bufs is None:
+                            bufs = [np.empty_like(t) for t in self.center]
+                        # recv_tensor(out=...) itself rejects shape/dtype
+                        # skew (ValueError -> eviction below)
+                        deltas = [conn.recv_tensor(out=b) for b in bufs]
+                    else:
+                        deltas = [conn.recv_tensor() for _ in self.center]
                     self._check_delta(deltas)   # before ANY apply: a
                     # config-skewed client is an eviction, never a torn or
                     # silently-dead worker (the serve loop polls drained)
                     conn.set_timeout(None)
                 except (TimeoutError, ConnectionError, ProtocolError,
                         OSError, ValueError) as e:
-                    self._evict(cid, e)
-                    # settle any stale tokens for the dead client so
-                    # ``drained`` cannot wedge on its queue
-                    import queue as _q
-                    while True:
-                        try:
-                            self._queues[cid - 1].get_nowait()
-                        except _q.Empty:
-                            break
-                        with self._lock:
-                            self._inflight -= 1
+                    self._evict(cid, e)        # drains this queue too
                     return
                 self._apply_delta(deltas)      # full delta only, atomically
             finally:
@@ -487,10 +537,20 @@ class AsyncEAClient:
         # clientGetCenter (lua :95-106)
         self.conn.send_msg(CENTER_Q)
         self.center = [self.conn.recv_tensor(out=c) for c in self.center]
-        # calculateUpdateDiff (lua :109-119): local EA math
+        # calculateUpdateDiff (lua :109-119): local EA math.  The scale is
+        # folded in-place into the one (p - c) temporary — at 100 MB-leaf
+        # scale a second full-size allocation per leaf is measurable on the
+        # sync path.
         leaves = _leaves(params)
-        deltas = [(p - c) * np.asarray(self.alpha, p.dtype)
-                  for p, c in zip(leaves, self.center)]
+        deltas = []
+        for p, c in zip(leaves, self.center):
+            # deltas go over the wire in the CENTER's dtype: the server
+            # rejects dtype skew as config skew, and a client whose local
+            # params drifted wider (e.g. f64 promotion) still interops —
+            # its delta is representable either way
+            d = np.asarray(p - c, dtype=c.dtype)
+            d *= np.asarray(self.alpha, d.dtype)
+            deltas.append(d)
         new_leaves = [p - d for p, d in zip(leaves, deltas)]
         # clientSendDiff (lua :122-132)
         self.conn.send_msg(DELTA_Q)

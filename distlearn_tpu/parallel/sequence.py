@@ -48,7 +48,8 @@ def _block_attn(q, k, v, scale, mask):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str, causal: bool = False) -> jax.Array:
+                   axis_name: str, causal: bool = False,
+                   impl: str | None = None) -> jax.Array:
     """Blockwise ring attention.
 
     Args:
@@ -56,10 +57,20 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         the concatenation over the mesh axis in rank order.
       axis_name: mesh axis carrying the sequence shards.
       causal: apply a causal mask over GLOBAL positions.
+      impl: single-device kernel choice, forwarded to
+        :func:`local_attention` when the axis has size 1 (the blockwise
+        ring math takes over for n > 1).
 
     Returns: local attention output ``[B, L_local, H, D]`` (q's dtype).
     """
-    n = lax.psum(1, axis_name)
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        # Degenerate ring: the whole sequence is local.  Delegate to the
+        # single-device kernel so the flash/chunked paths (no O(L^2)
+        # score buffer / causal FLOP skip) stay available — the blockwise
+        # fallback below would materialize the full [B,H,L,L] s_exp for
+        # its one block.
+        return local_attention(q, k, v, causal=causal, impl=impl)
     my = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -95,7 +106,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                       axis_name: str, causal: bool = False) -> jax.Array:
+                       axis_name: str, causal: bool = False,
+                       impl: str | None = None) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Two ``all_to_all`` collectives swap the SEQUENCE sharding for a HEAD
@@ -110,7 +122,9 @@ def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q/k/v: local shards ``[B, L_local, H, D]`` (global sequence = rank-order
     concatenation over the axis).  Returns ``[B, L_local, H, D]``.
     """
-    n = lax.psum(1, axis_name)
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return local_attention(q, k, v, causal=causal, impl=impl)
     H = q.shape[2]
     if H % n:
         raise ValueError(
@@ -124,10 +138,57 @@ def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               tiled=True)
 
     out = local_attention(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
-                          causal=causal)        # full-sequence, local heads
+                          causal=causal, impl=impl)  # full-seq, local heads
     # [B, L, H/n, D] -> [B, L_loc, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
+
+
+def chunked_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                             chunk: int = 1024) -> jax.Array:
+    """Causal attention with the masked half of the score matrix never
+    computed — a portable (pure-XLA) counterpart to flash attention tuned
+    for the opposite end of the memory/compute trade.
+
+    The query axis is split into static chunks; chunk ``i`` attends only
+    to keys ``[0, (i+1)*chunk)``, so the matmul and exp work is the causal
+    ~L^2/2 rather than the full L^2 the naive path computes-then-masks.
+    Unlike flash, the per-chunk softmax weights are left for XLA to save
+    as backward residuals: the backward pass re-runs NO exp.  On v5e the
+    lm_long config is exp/VPU-bound, where flash pays ~3x the exp count
+    (forward + two backward recomputes) of this path's 1x — measured
+    (docs/PERF.md): chunked beats both flash and the naive path at
+    seq 4096 while using O(L^2/2) f32 residual memory, which fits at the
+    batch sizes a 16 GB chip trains at this length anyway.  For long
+    sequences at larger batch, flash remains the memory-bound choice.
+
+    Only the diagonal sub-block gets a mask; the strict-past prefix is
+    computed unmasked — no [L, L] predicate materialization.
+    """
+    B, L, H, D = q.shape
+    if L % chunk or L <= chunk:
+        return local_attention(q, k, v, causal=True, impl="xla")
+    scale = 1.0 / (D ** 0.5)
+    pos = jnp.arange(chunk)
+    diag_mask = pos[:, None] >= pos[None, :]          # [chunk, chunk]
+    outs = []
+    for i in range(L // chunk):
+        qs = q[:, i * chunk:(i + 1) * chunk]
+        parts = []
+        if i:  # strictly-past keys: fully visible, no mask at all
+            s_pre = jnp.einsum("bqhd,bkhd->bhqk", qs, k[:, :i * chunk],
+                               preferred_element_type=jnp.float32) * scale
+            parts.append(s_pre)
+        s_diag = jnp.einsum("bqhd,bkhd->bhqk", qs,
+                            k[:, i * chunk:(i + 1) * chunk],
+                            preferred_element_type=jnp.float32) * scale
+        parts.append(jnp.where(diag_mask[None, None], s_diag, -jnp.inf))
+        s = jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+        w = jax.nn.softmax(s, axis=-1)                # f32, saved for bwd
+        outs.append(jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype),
+                               v[:, :(i + 1) * chunk],
+                               preferred_element_type=jnp.float32))
+    return jnp.concatenate(outs, axis=1).astype(q.dtype)
 
 
 def _flash_enabled(override: bool | None) -> bool:
@@ -145,16 +206,36 @@ def _flash_enabled(override: bool | None) -> bool:
 
 def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
-                    flash: bool | None = None) -> jax.Array:
+                    flash: bool | None = None,
+                    impl: str | None = None) -> jax.Array:
     """Single-device attention (same layout as the sharded variants), for
     non-sharded runs and as the per-shard kernel of
     :func:`alltoall_attention`.  q/k/v: [B, L, H, D].
 
-    With ``flash`` (or ``DISTLEARN_TPU_FLASH=1``) the inner kernel is the
-    Pallas TPU flash attention — blockwise online softmax in VMEM, no
-    ``[B, H, L, L]`` score materialization."""
+    ``impl`` picks the kernel: ``"xla"`` (naive fused, full [B,H,L,L]
+    scores), ``"flash"`` (Pallas blockwise online softmax, no score
+    materialization), or ``"chunked"`` (:func:`chunked_causal_attention`
+    — causal FLOP skip with saved softmax weights; falls back to xla for
+    non-causal or short/ragged L).  Default resolution: the ``flash``
+    arg (back-compat), then the ``DISTLEARN_TPU_ATTN`` env var, then
+    ``DISTLEARN_TPU_FLASH``, then xla."""
     B, L, H, D = q.shape
-    if _flash_enabled(flash):
+    explicit_flash = flash is True or impl == "flash"
+    if impl is None:
+        if flash is not None:
+            impl = "flash" if flash else "xla"
+        else:
+            import os
+            impl = os.environ.get("DISTLEARN_TPU_ATTN") \
+                or ("flash" if _flash_enabled(None) else "xla")
+    if impl not in ("xla", "flash", "chunked"):
+        raise ValueError(f"attention impl must be 'xla', 'flash', or "
+                         f"'chunked', got {impl!r}")
+    if impl == "chunked":
+        if causal and L > 1024 and L % 1024 == 0:
+            return chunked_causal_attention(q, k, v)
+        impl = "xla"     # chunking only pays off via the causal FLOP skip
+    if impl == "flash":
         # the Pallas kernel's default blocking needs L to be a multiple of
         # its 128-wide blocks
         supported = jax.default_backend() == "tpu" and L >= 128 and L % 128 == 0
@@ -166,13 +247,16 @@ def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 v.transpose(0, 2, 1, 3), causal=causal,
                 sm_scale=1.0 / (D ** 0.5))
             return out.transpose(0, 2, 1, 3).astype(q.dtype)
-        if flash:
-            # explicitly requested — refusing loudly beats silently
-            # materializing the O(L^2) buffer the caller asked to avoid
+        if explicit_flash:
+            # explicitly requested (flash=True or impl="flash" argument) —
+            # refusing loudly beats silently materializing the O(L^2)
+            # buffer the caller asked to avoid; env-driven requests fall
+            # back quietly so one flag can cover mixed configs
             raise ValueError(
                 "flash attention needs the TPU backend and seq len a "
                 f"multiple of 128; got backend={jax.default_backend()}, "
-                f"L={L}. Drop flash=True to use the portable path.")
+                f"L={L}. Drop the explicit flash request to use the "
+                "portable path.")
         # env-enabled but unsupported here: portable fallback
     scale = 1.0 / (D ** 0.5)
     # native-dtype inputs + f32 ACCUMULATION: on bf16 configs the MXU runs

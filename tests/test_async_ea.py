@@ -483,3 +483,63 @@ def test_server_evicts_config_skewed_client_before_apply():
     assert 1 in srv.evicted
     np.testing.assert_array_equal(srv.center[0], init["w"])  # untouched
     srv.close()
+
+
+def test_server_evicts_dtype_skewed_client_before_apply():
+    """A right-shaped but wrong-DTYPE delta (e.g. f64 from a config-skewed
+    client) is config skew too: eviction, center untouched — never a
+    silent astype into the center (ADVICE r3)."""
+    port = _ports()
+    init = {"w": np.ones(16, np.float32)}
+
+    def skewed_client():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        c.center = [c.broadcast.recv_tensor()]
+        c.broadcast.send_msg({"q": "Enter?", "clientID": 1})
+        c.conn.recv_msg()                    # ENTER
+        c.conn.send_msg("Center?")
+        c.conn.recv_tensor()
+        c.conn.send_msg("delta?")
+        c.conn.recv_msg()                    # delta
+        c.conn.send_tensor(np.ones(16, np.float64))  # right shape, wrong dtype
+        c.close()
+
+    t = threading.Thread(target=skewed_client, daemon=True)
+    t.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1,
+                        accept_timeout=60.0, handshake_timeout=5.0)
+    srv.init_server({"w": init["w"].copy()})
+    with pytest.raises((TimeoutError, RuntimeError)):
+        srv.sync_server({"w": init["w"]}, timeout=5.0)
+    t.join(timeout=10.0)
+    assert 1 in srv.evicted
+    np.testing.assert_array_equal(srv.center[0], init["w"])  # untouched
+    srv.close()
+
+
+def test_client_wide_dtype_params_interop():
+    """A client whose local params drifted to f64 still syncs: deltas go
+    over the wire in the CENTER's dtype (f32), so the strict server-side
+    dtype check passes and the elastic math stays consistent."""
+    port = _ports()
+    out = {}
+
+    def client():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        p = c.init_client({"w": np.zeros(8, np.float32)})
+        p = {"w": p["w"].astype(np.float64) + 2.0}   # f64 drift
+        p, synced = c.sync_client(p)
+        out["synced"] = synced
+        out["p"] = p
+        c.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1, accept_timeout=60.0)
+    srv.init_server({"w": np.zeros(8, np.float32)})
+    srv.sync_server({"w": np.zeros(8, np.float32)})
+    t.join(timeout=10.0)
+    assert out["synced"]
+    assert srv.center[0].dtype == np.float32
+    np.testing.assert_allclose(srv.center[0], 1.0)   # (2-0)*0.5 applied
+    np.testing.assert_allclose(out["p"]["w"], 1.0)   # p -= delta

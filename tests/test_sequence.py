@@ -205,3 +205,58 @@ def test_bf16_attention_matches_f32_reference():
     np.testing.assert_allclose(np.asarray(out_ring, np.float32),
                                np.asarray(out_local, np.float32),
                                rtol=0.02, atol=0.01)
+
+
+# --- chunked causal attention (parallel/sequence.py chunked_causal_attention)
+
+
+def test_chunked_causal_matches_local():
+    """The chunk-skipped score computation is the same math as the full
+    masked path — forward and gradients (the saved-softmax backward)."""
+    from distlearn_tpu.parallel.sequence import chunked_causal_attention
+    rng = np.random.RandomState(3)
+    mk = lambda: jnp.asarray(rng.randn(2, 64, 4, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+
+    ref = local_attention(q, k, v, causal=True, impl="xla")
+    got = chunked_causal_attention(q, k, v, chunk=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+    g_ref = jax.grad(lambda a: jnp.sum(
+        local_attention(a, k, v, causal=True, impl="xla") ** 2))(q)
+    g_got = jax.grad(lambda a: jnp.sum(
+        chunked_causal_attention(a, k, v, chunk=16) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_causal_ragged_falls_back():
+    """L not divisible by the chunk (or too short) silently uses the xla
+    path — same numbers either way."""
+    from distlearn_tpu.parallel.sequence import chunked_causal_attention
+    rng = np.random.RandomState(4)
+    mk = lambda: jnp.asarray(rng.randn(1, 24, 2, 8).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    got = chunked_causal_attention(q, k, v, chunk=16)
+    ref = local_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_local_attention_impl_validation():
+    q = jnp.zeros((1, 8, 1, 4))
+    with pytest.raises(ValueError, match="impl"):
+        local_attention(q, q, q, impl="bogus")
+
+
+def test_local_attention_chunked_impl_dispatch():
+    """impl='chunked' on a causal call routes through the chunked path and
+    still matches the oracle (CPU: flash unsupported, chunked is portable)."""
+    rng = np.random.RandomState(5)
+    mk = lambda: jnp.asarray(rng.randn(1, 2048, 2, 8).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    got = local_attention(q, k, v, causal=True, impl="chunked")
+    ref = local_attention(q, k, v, causal=True, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)

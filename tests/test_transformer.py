@@ -4,6 +4,7 @@ outputs must match the single-device model exactly (same full params)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distlearn_tpu.models.transformer import (lm_loss, param_specs,
@@ -94,15 +95,23 @@ def test_remat_matches_no_remat():
     toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)),
                        jnp.int32)
     outs, grads = {}, {}
-    for remat in (False, True):
+    for remat in (False, True, "mlp"):
         lm = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=16,
                             remat=remat)
         params, _ = lm.init(random.PRNGKey(0))
         outs[remat] = np.asarray(lm.apply(params, {}, toks)[0])
         grads[remat] = jax.grad(
             lambda p: lm_loss(lm, p, toks))(params)
-    np.testing.assert_allclose(outs[False], outs[True], rtol=1e-6, atol=1e-7)
-    for a, b in zip(jax.tree_util.tree_leaves(grads[False]),
-                    jax.tree_util.tree_leaves(grads[True])):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-7)
+    for mode in (True, "mlp"):
+        np.testing.assert_allclose(outs[False], outs[mode],
+                                   rtol=1e-6, atol=1e-7)
+        for a, b in zip(jax.tree_util.tree_leaves(grads[False]),
+                        jax.tree_util.tree_leaves(grads[mode])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+
+def test_remat_mode_validation():
+    from distlearn_tpu.models.transformer import transformer_lm
+    with pytest.raises(ValueError, match="remat"):
+        transformer_lm(vocab=8, dim=8, depth=1, heads=1, remat="bogus")
