@@ -358,6 +358,186 @@ def allreduce_proxy_cpu8(size_mb: int):
         return None
 
 
+# Approximate PUBLIC per-link one-direction ICI bandwidth (GB/s) by chip
+# generation — the ring-allreduce busbw ceiling (each chip drives one link
+# per direction in the steady state).  Used only to turn a measured busbw
+# into the BASELINE.md "ICI allreduce efficiency" percentage on REAL
+# multi-chip meshes; never applied to the CPU proxy.
+_ICI_LINK_GB_S = (
+    ("v6", 90.0),
+    ("v5p", 90.0),
+    ("v5 lite", 45.0),
+    ("v5e", 45.0),
+    ("v4", 45.0),
+    ("v3", 70.0),
+)
+
+
+def _ici_link_spec():
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for sub, bw in _ICI_LINK_GB_S:
+        if sub in kind:
+            return bw
+    return None
+
+
+def multichip_suite(ar_mb: int = 64):
+    """The measurements that only mean something on a multi-device mesh,
+    in one function that runs UNMODIFIED on any device count — so the day
+    real multi-chip hardware is attached, hardware day is measurement day
+    (VERDICT r3 #3).  Rows:
+
+    * ``allreduce``: psum busbw on the full mesh; on a real TPU mesh also
+      ``ici_efficiency`` vs the public per-link spec (BASELINE.md's >=90%
+      v4-32 target row).
+    * ``dp_scaling``: the headline CIFAR scanned AllReduceSGD step at
+      fixed per-device batch on a 1-device vs full mesh — weak-scaling
+      efficiency (each n-device step does n times the work).
+    * ``easgd_round``: one fused elastic round (the EASGD collective) on
+      the full mesh.
+    * ``pp_lm``: a REAL S>1 pipeline row — GPipe LM train step over
+      (1, S) stages, microbatched.
+
+    On the 1-real-chip host, main() runs this via a subprocess on the
+    8-device virtual CPU mesh and labels every row ``proxy`` — protocol
+    evidence, not bandwidth evidence.
+    """
+    import jax
+    import numpy as np
+    from jax import random
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    out: dict = {"devices": n_dev, "platform": platform}
+
+    # -- allreduce busbw vs ICI spec ----------------------------------------
+    ar = allreduce_bench(ar_mb)
+    spec = _ici_link_spec() if platform == "tpu" else None
+    if spec:
+        ar["ici_link_spec_gb_s"] = spec
+        ar["ici_efficiency"] = ar["busbw_gb_s"] / spec
+    out["allreduce"] = ar
+
+    # -- DP weak scaling of the headline step -------------------------------
+    # CPU-proxy runs shrink the workload: the convnet step is seconds per
+    # call on one CPU core, and the proxy's job is protocol/scaling-shape
+    # evidence, not throughput
+    on_tpu = platform == "tpu"
+    per_dev_batch = int(os.environ.get("BENCH_MC_BATCH",
+                                       "64" if on_tpu else "8"))
+    scan_k = max(1, int(os.environ.get("BENCH_MC_SCAN_K",
+                                       "4" if on_tpu else "2")))
+    iters = int(os.environ.get("BENCH_MC_ITERS", "5" if on_tpu else "2"))
+
+    def cifar_sps(num_nodes):
+        from distlearn_tpu.train import build_sgd_scan_step, init_train_state
+        from distlearn_tpu.models import cifar_convnet
+        from distlearn_tpu.parallel.mesh import MeshTree
+        import jax.numpy as jnp
+        tree = MeshTree(num_nodes=num_nodes)
+        model = cifar_convnet(
+            compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+        ts = init_train_state(model, tree, random.PRNGKey(0), 10)
+        step = build_sgd_scan_step(model, tree, lr=0.1)
+        bx, by = _stacked_cifar_batches(tree, per_dev_batch * num_nodes,
+                                        scan_k)
+        sps, _, _ = bench_step_fn(step, ts, bx, by, iters * scan_k, 3,
+                                  scan_k, steps_per_call=scan_k)
+        return sps
+
+    sps_1 = cifar_sps(1)
+    sps_n = cifar_sps(n_dev) if n_dev > 1 else sps_1
+    out["dp_scaling"] = {
+        "per_device_batch": per_dev_batch,
+        "steps_per_sec_1dev": sps_1,
+        "steps_per_sec_full": sps_n,
+        # each full-mesh step processes n_dev x the examples
+        "weak_scaling_efficiency": (sps_n / sps_1) if sps_1 else None,
+    }
+
+    # -- one fused EASGD elastic round --------------------------------------
+    from distlearn_tpu.train import build_ea_cycle, init_ea_state
+    tree, model = _cifar_model_and_tree()
+    ets = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    cyc = build_ea_cycle(model, tree, lr=0.1, alpha=0.2)
+    tau = int(os.environ.get("BENCH_EA_TAU", "10" if on_tpu else "2"))
+    bx, by = _stacked_cifar_batches(tree, per_dev_batch * n_dev, tau)
+    # one cyc() call = tau local steps + ONE elastic round
+    ea_sps, _, _ = bench_step_fn(cyc, ets, bx, by, 3 * tau, 3, tau,
+                                 steps_per_call=tau)
+    out["easgd_round"] = {"tau": tau,
+                          "cycles_per_sec": ea_sps / tau,
+                          "local_steps_per_sec": ea_sps}
+
+    # -- real S>1 pipeline row ----------------------------------------------
+    if n_dev >= 2:
+        import jax.numpy as jnp
+        from distlearn_tpu.models.transformer import transformer_lm
+        from distlearn_tpu.train.lm import build_lm_pp_step, stack_blocks
+        S = min(4, n_dev)
+        M = int(os.environ.get("BENCH_MC_PP_MICROBATCHES",
+                               "8" if on_tpu else "4"))
+        dim = int(os.environ.get("BENCH_MC_PP_DIM",
+                                 "256" if on_tpu else "64"))
+        seq = int(os.environ.get("BENCH_MC_PP_SEQ",
+                                 "128" if on_tpu else "64"))
+        depth = 2 * S
+        pp_mesh = Mesh(np.asarray(jax.devices()[:S]).reshape(1, S),
+                       ("data", "pipe"))
+        lm = transformer_lm(vocab=2048, dim=dim, depth=depth,
+                            heads=max(1, dim // 64), max_len=seq,
+                            compute_dtype=jnp.bfloat16
+                            if platform == "tpu" else None)
+        params, _ = lm.init(random.PRNGKey(1))
+        shared, stacked = stack_blocks(params, depth)
+        shared = jax.device_put(shared, NamedSharding(pp_mesh, P()))
+        stacked = jax.device_put(stacked, NamedSharding(pp_mesh, P("pipe")))
+        step = build_lm_pp_step(pp_mesh, shared, stacked, lr=0.1,
+                                num_microbatches=M, remat=True)
+        toks = jax.device_put(
+            np.random.RandomState(0).randint(0, 2048, (M * 2, seq))
+            .astype(np.int32), NamedSharding(pp_mesh, P("data")))
+        st = {"s": shared, "k": stacked}
+
+        def run_pp(k):
+            sh, stk = st["s"], st["k"]
+            for _ in range(k):
+                sh, stk, loss = step(sh, stk, toks)
+            st["s"], st["k"] = sh, stk
+            float(jax.device_get(loss))
+
+        med, _ = timed_windows(lambda: run_pp(3), lambda: run_pp(1), 3)
+        out["pp_lm"] = {
+            "stages": S, "microbatches": M, "dim": dim, "depth": depth,
+            "seq_len": seq, "steps_per_sec": 3 / med,
+            "tokens_per_sec": 3 * M * 2 * seq / med,
+            "bubble_fraction": (S - 1) / (M + S - 1),
+        }
+    return out
+
+
+def multichip_proxy_cpu(n: int = 8):
+    """1-chip host: run :func:`multichip_suite` on an ``n``-device virtual
+    CPU mesh in a subprocess (same command path real hardware will take),
+    labeling the result a proxy."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--multichip-probe"],
+            env=env, capture_output=True, timeout=1800, text=True)
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        rec["proxy"] = "cpu_virtual_mesh"
+        return rec
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] multichip proxy failed: {e}", file=sys.stderr)
+        if 'out' in dir() and out.stderr:
+            print(out.stderr[-800:], file=sys.stderr)
+        return None
+
+
 def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
     """Host (DCN/TCP) backend microbench: the same payload allreduced through
     the base-2 tree (the reference's topology, ``T*log2(N)`` —
@@ -550,6 +730,17 @@ def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
                                  remat)
 
 
+def _lm_dim_depth():
+    """The LM bench model size, shared by the measurement and the
+    remat-mode heuristic so the two can never size different models."""
+    dim = int(os.environ.get("BENCH_LM_DIM", "1024"))
+    depth = int(os.environ.get("BENCH_LM_DEPTH", "8"))
+    if dim < 64 or dim % 64:
+        raise ValueError(f"BENCH_LM_DIM must be a multiple of 64 "
+                         f"(64-dim heads), got {dim}")
+    return dim, depth
+
+
 def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
     import jax
     import jax.numpy as jnp
@@ -563,11 +754,7 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
     devs = jax.devices()
     mesh = Mesh(np.asarray(devs[:1]).reshape(1, 1, 1),
                 ("data", "seq", "model"))
-    dim = int(os.environ.get("BENCH_LM_DIM", "1024"))
-    depth = int(os.environ.get("BENCH_LM_DEPTH", "8"))
-    if dim < 64 or dim % 64:
-        raise ValueError(f"BENCH_LM_DIM must be a multiple of 64 "
-                         f"(64-dim heads), got {dim}")
+    dim, depth = _lm_dim_depth()
     lm = transformer_lm(vocab=32768, dim=dim, depth=depth, heads=dim // 64,
                         max_len=seq, compute_dtype=jnp.bfloat16, remat=remat,
                         attn_impl=attn)
@@ -800,6 +987,30 @@ def bench_pp_lm(batch, seq, iters, windows, peak):
     }
 
 
+def chip_health_probe():
+    """Chained bf16 4096^3 matmuls ended by a REAL device_get (the
+    platform's completion signaling is optimistic — r1 lesson).  Healthy
+    v5e measures ~100-143 TFLOP/s here; the attached chip/tunnel has been
+    observed degraded 25x (5.8 TFLOP/s) for extended windows.  Recorded
+    with every run so a depressed benchmark row is attributable to the
+    environment, not mistaken for a framework regression."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    x = jnp.ones((4096, 4096), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a / 64.0)
+    _ = np.asarray(jax.device_get(f(x)))
+    t0 = _t.perf_counter()
+    N = 30
+    r = x
+    for _ in range(N):
+        r = f(r)
+    _ = np.asarray(jax.device_get(r))
+    return 2 * 4096**3 * N / (_t.perf_counter() - t0) / 1e12
+
+
 def main():
     _enable_compile_cache()
     batch = int(os.environ.get("BENCH_BATCH", "256"))
@@ -810,6 +1021,13 @@ def main():
     platform, kind, peak = detect_peak_flops()
     details: dict = {"protocol": PROTOCOL, "platform": platform,
                      "device_kind": kind, "peak_bf16_flops": peak}
+    if platform == "tpu":
+        probe = run_bench_section("chip_health", chip_health_probe)
+        if probe is not None:
+            details["chip_health_tflops"] = probe
+            print(f"[bench] chip health probe: {probe:.1f} TFLOP/s "
+                  "(chained bf16 matmul; healthy ~100-143, degraded "
+                  "windows observed at ~6)", file=sys.stderr)
 
     # --- headline: CIFAR-10 convnet fused AllReduceSGD ---------------------
     # Measured on the SCANNED step (train.build_sgd_scan_step: K chained
@@ -879,8 +1097,14 @@ def main():
                   file=sys.stderr)
 
     # --- gradient allreduce bandwidth --------------------------------------
+    # (when the multichip suite runs below it produces this same
+    # measurement as its first row — reuse it instead of paying the
+    # 20-iter collective twice)
     ar_mb = int(os.environ.get("BENCH_AR_MB", "64"))
-    if n_dev > 1:
+    mc_will_run = os.environ.get("BENCH_SKIP_MULTICHIP") != "1"
+    if mc_will_run:
+        details["allreduce"] = None       # filled from the multichip row
+    elif n_dev > 1:
         details["allreduce"] = allreduce_bench(ar_mb)
     else:
         details["allreduce"] = allreduce_proxy_cpu8(ar_mb)
@@ -889,6 +1113,38 @@ def main():
         print(f"[bench] allreduce {ar['payload_mb']}MB x{ar['devices']} "
               f"({ar.get('proxy', 'device mesh')}): "
               f"busbw {ar['busbw_gb_s']:.2f} GB/s", file=sys.stderr)
+
+    # --- multichip suite (real mesh when available; labeled CPU proxy) ------
+    if mc_will_run:
+        if n_dev > 1:
+            details["multichip"] = run_bench_section(
+                "multichip", lambda: multichip_suite(ar_mb))
+        else:
+            details["multichip"] = multichip_proxy_cpu(
+                int(os.environ.get("BENCH_MC_DEVICES", "8")))
+        mc = details.get("multichip")
+        if mc:
+            details["allreduce"] = dict(mc["allreduce"])
+            if "proxy" in mc:
+                details["allreduce"]["proxy"] = "cpu8_virtual_mesh"
+            a2 = details["allreduce"]
+            print(f"[bench] allreduce {a2['payload_mb']}MB x"
+                  f"{a2['devices']} ({a2.get('proxy', 'device mesh')}): "
+                  f"busbw {a2['busbw_gb_s']:.2f} GB/s", file=sys.stderr)
+        if mc:
+            tag = mc.get("proxy", "real mesh")
+            ar_mc = mc["allreduce"]
+            eff = (f", ICI eff {ar_mc['ici_efficiency']:.0%}"
+                   if "ici_efficiency" in ar_mc else "")
+            print(f"[bench] multichip ({tag}, {mc['devices']} dev): "
+                  f"allreduce busbw {ar_mc['busbw_gb_s']:.2f} GB/s{eff}; "
+                  f"dp weak-scaling "
+                  f"{mc['dp_scaling']['weak_scaling_efficiency']:.2f}; "
+                  f"easgd {mc['easgd_round']['cycles_per_sec']:.2f} "
+                  "cycles/s"
+                  + (f"; pp S={mc['pp_lm']['stages']} "
+                     f"{mc['pp_lm']['tokens_per_sec']:.0f} tok/s"
+                     if "pp_lm" in mc else ""), file=sys.stderr)
 
     # --- host (DCN/TCP) backend: tree vs ring --------------------------------
     if os.environ.get("BENCH_SKIP_HOST") != "1":
@@ -1008,9 +1264,7 @@ def main():
             cfgs = os.environ.get("BENCH_LM_LONG_CFGS",
                                   "1x4096,1x8192,4x4096")
         lci = int(os.environ.get("BENCH_LM_LONG_ITERS", "15"))
-        # same dim/depth _bench_transformer_lm will parse (and validate)
-        lm_dim = int(os.environ.get("BENCH_LM_DIM", "1024"))
-        lm_depth = int(os.environ.get("BENCH_LM_DEPTH", "8"))
+        lm_dim, lm_depth = _lm_dim_depth()
         rows = []
         for cfg in cfgs.split(","):
             lcb, lcs = (int(v) for v in cfg.strip().split("x"))
@@ -1107,6 +1361,11 @@ if __name__ == "__main__":
         _pin_cpu(int(os.environ.get("BENCH_AR_DEVICES", "8")))
         _enable_compile_cache()
         print(json.dumps(allreduce_bench(
+            int(os.environ.get("BENCH_AR_MB", "64")))))
+    elif "--multichip-probe" in sys.argv:
+        _pin_cpu(int(os.environ.get("BENCH_MC_DEVICES", "8")))
+        _enable_compile_cache()
+        print(json.dumps(multichip_suite(
             int(os.environ.get("BENCH_AR_MB", "64")))))
     else:
         main()

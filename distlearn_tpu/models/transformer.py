@@ -159,6 +159,16 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     picks the sequence-parallel attention: ``"ring"`` (neighbor-hop K/V
     rotation, unbounded L) or ``"alltoall"`` (Ulysses head-scatter — needs
     heads divisible by the seq axis and the full score block in memory).
+    ``attn_impl`` picks the single-device attention kernel
+    (``"xla"``/``"flash"``/``"chunked"`` — see
+    :func:`distlearn_tpu.parallel.sequence.local_attention`; None = env
+    default).  It applies whenever the attention runs locally: no
+    ``seq_axis``, or a size-1 sequence axis.  With a real (>1) sequence
+    axis the ring/all-to-all blockwise math takes over and the knob is
+    inert — see :func:`distlearn_tpu.parallel.sequence.ring_attention`
+    for why (and for the zigzag layout that does the causal FLOP cut
+    there).
+
     ``remat=True`` (= ``"full"``) wraps each block in ``jax.checkpoint``:
     activations are recomputed in the backward pass instead of saved — HBM
     drops from O(depth * L * dim) to O(L * dim) at ~1/3 extra FLOPs, the
@@ -188,11 +198,14 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     if seq_impl not in ("ring", "alltoall"):
         raise ValueError(f"seq_impl must be 'ring' or 'alltoall', "
                          f"got {seq_impl!r}")
-    if remat not in (False, True, "full", "mlp"):
-        raise ValueError(f"remat must be False, True/'full', or 'mlp', "
-                         f"got {remat!r}")
-    if remat is True:
-        remat = "full"
+    if isinstance(remat, str):
+        if remat not in ("full", "mlp"):
+            raise ValueError(f"remat must be False, True/'full', or 'mlp', "
+                             f"got {remat!r}")
+    else:
+        # any truthy non-string (True, 1, ...) means full remat — int-ish
+        # config flags must not silently disable checkpointing
+        remat = "full" if remat else False
     if moe_experts < 0 or (moe_experts > 0 and moe_every < 1):
         raise ValueError(f"moe_experts must be >= 0 and moe_every >= 1, "
                          f"got {moe_experts}/{moe_every}")
@@ -249,15 +262,43 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
         return params, {}
 
     def apply(params, state, tokens, train=True, rng=None, axis_name=None,
-              bn_weight=None, seq_axis=None, tp_axis=None, ep_axis=None):
+              bn_weight=None, seq_axis=None, tp_axis=None, ep_axis=None,
+              seq_layout="contig"):
         B, L = tokens.shape
+        sa = seq_attn
+        if seq_layout not in ("contig", "zigzag"):
+            raise ValueError(f"seq_layout must be 'contig' or 'zigzag', "
+                             f"got {seq_layout!r}")
+        if seq_layout == "zigzag":
+            if seq_axis is None:
+                raise ValueError(
+                    "seq_layout='zigzag' without a sequence axis: the "
+                    "layout permutes data across shards — drop it for "
+                    "single-shard runs")
+            if seq_impl != "ring":
+                raise ValueError(
+                    "seq_layout='zigzag' needs seq_impl='ring' (the "
+                    "all-to-all path applies its causal mask in natural "
+                    "order)")
+            import functools
+            sa = functools.partial(seq_attn, layout="zigzag")
         if seq_axis is not None:
-            offset = lax.axis_index(seq_axis) * L
+            my = lax.axis_index(seq_axis)
+            if seq_layout == "zigzag":
+                # local shard = early stripe my ++ late stripe 2n-1-my
+                n_sh = lax.axis_size(seq_axis)
+                s_len = L // 2
+                pa = lax.dynamic_slice_in_dim(params["pos"], my * s_len,
+                                              s_len)
+                pb = lax.dynamic_slice_in_dim(
+                    params["pos"], (2 * n_sh - 1 - my) * s_len, s_len)
+                pos_emb = jnp.concatenate([pa, pb], axis=0)
+            else:
+                pos_emb = lax.dynamic_slice_in_dim(params["pos"], my * L, L)
         else:
-            offset = 0
+            pos_emb = lax.dynamic_slice_in_dim(params["pos"], 0, L)
         x = params["embed"][tokens].astype(cd)
-        x = x + lax.dynamic_slice_in_dim(params["pos"], offset, L
-                                         ).astype(cd)[None]
+        x = x + pos_emb.astype(cd)[None]
 
         def make_block(is_moe):
             if remat == "mlp":
@@ -271,14 +312,14 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                 ffn_ckpt = jax.checkpoint(ffn)
 
                 def block(blk, x):
-                    x = attn_apply(blk, x, cd, seq_attn=seq_attn,
+                    x = attn_apply(blk, x, cd, seq_attn=sa,
                                    seq_axis=seq_axis, tp_axis=tp_axis,
                                    attn_impl=attn_impl)
                     return ffn_ckpt(blk, x)
                 return block
 
             def block(blk, x):
-                return block_apply(blk, x, cd, seq_attn=seq_attn,
+                return block_apply(blk, x, cd, seq_attn=sa,
                                    seq_axis=seq_axis, tp_axis=tp_axis,
                                    ep_axis=ep_axis,
                                    moe_capacity_factor=moe_capacity_factor,
@@ -344,7 +385,7 @@ def param_specs(params: PyTree, tp_axis: str | None,
 
 def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
             ep_axis=None, reduce: bool = True,
-            moe_balance_weight: float = 0.0):
+            moe_balance_weight: float = 0.0, seq_layout: str = "contig"):
     """Next-token cross-entropy.  With a sequence axis, the final position's
     target lives on the next shard — the shift rides a ppermute so the loss
     is exact across shard boundaries.
@@ -361,7 +402,7 @@ def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
     stable MoE training; ignored for dense models."""
     logits, st = model.apply(params, {}, tokens, train=True,
                              seq_axis=seq_axis, tp_axis=tp_axis,
-                             ep_axis=ep_axis)
+                             ep_axis=ep_axis, seq_layout=seq_layout)
     bal = (moe_balance_weight * st["moe_balance_loss"]
            if moe_balance_weight and isinstance(st, dict)
            and "moe_balance_loss" in st else None)
@@ -371,19 +412,41 @@ def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
         nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
         loss = nll.mean()
         return loss + bal if bal is not None else loss
-    # first token of the NEXT shard (ring shift by -1)
-    n = lax.psum(1, seq_axis)
-    perm = [(j, (j - 1) % n) for j in range(n)]
-    nxt_first = lax.ppermute(tokens[:, :1], seq_axis, perm)  # [B,1]
-    targets = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
-    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
-    nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
-    # the global last position has no target: mask it; normalize by the
-    # GLOBAL token count (a constant — no gradient flows through it)
+    n = lax.axis_size(seq_axis)
     my = lax.axis_index(seq_axis)
     L = tokens.shape[1]
-    pos = my * L + jnp.arange(L)
-    w = (pos < n * L - 1).astype(jnp.float32)
+    if seq_layout == "zigzag":
+        # local shard = early stripe a=my ++ late stripe b=2n-1-my.  Each
+        # stripe's boundary target is the HEAD of the globally-next
+        # stripe: stripe a+1 is rank my+1's early stripe (except a+1 == n,
+        # which is rank n-1's own LATE stripe), and stripe b+1 = 2n-my is
+        # rank my-1's late stripe (except b == 2n-1 on rank 0 — the
+        # global end, masked below).  Two neighbor ppermutes deliver both.
+        s_len = L // 2
+        ta, tb = tokens[:, :s_len], tokens[:, s_len:]
+        early_head, late_head = tokens[:, :1], tokens[:, s_len:s_len + 1]
+        from_next = lax.ppermute(early_head, seq_axis,
+                                 [(j, (j - 1) % n) for j in range(n)])
+        from_prev = lax.ppermute(late_head, seq_axis,
+                                 [(j, (j + 1) % n) for j in range(n)])
+        bound_a = jnp.where(my == n - 1, late_head, from_next)
+        targets = jnp.concatenate([ta[:, 1:], bound_a, tb[:, 1:],
+                                   from_prev], axis=1)
+        # only the global last position (rank 0's late-stripe tail) has
+        # no target
+        w = jnp.ones((L,), jnp.float32).at[-1].set(
+            jnp.where(my == 0, 0.0, 1.0))
+    else:
+        # first token of the NEXT shard (ring shift by -1)
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        nxt_first = lax.ppermute(tokens[:, :1], seq_axis, perm)  # [B,1]
+        targets = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
+        pos = my * L + jnp.arange(L)
+        w = (pos < n * L - 1).astype(jnp.float32)
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
+    # mask the target-less global last position; normalize by the GLOBAL
+    # token count (a constant — no gradient flows through it)
     count = lax.psum(jnp.sum(w) * tokens.shape[0], seq_axis)
     local = jnp.sum(nll * w[None, :]) / jnp.maximum(count, 1.0)
     if bal is not None:

@@ -129,6 +129,15 @@ class AsyncEAServer:
                     f"delta leaf dtype {d.dtype} != center {t.dtype} — "
                     "client/server model config skew")
 
+    def _apply_delta(self, deltas: list[np.ndarray]):
+        """Fold a fully-received, validated delta into the center.  The
+        serial server mutates in place; the concurrent subclass overrides
+        this with its immutable-publish version (so the serial
+        ``sync_server`` API keeps working on a concurrent server, whose
+        center leaves are frozen)."""
+        for t, d in zip(self.center, deltas):
+            t += d              # dtypes equal (checked) — no astype copy
+
     def _evict(self, cid: int, why: Exception):
         """Drop a dead/hung client: close both its channels so recv_any stops
         selecting it; remaining clients keep syncing."""
@@ -220,8 +229,7 @@ class AsyncEAServer:
                     ValueError) as e:   # ValueError: undecodable JSON frame
                 self._evict(cid, e)
                 continue
-            for t, delta in zip(self.center, deltas):
-                t += delta          # dtypes equal (checked) — no astype copy
+            self._apply_delta(deltas)
             print_server(f"received delta from client #{self.current_client}")
             return _rebuild(params, [t.copy() for t in self.center])
 

@@ -132,3 +132,135 @@ def pipeline_apply(stage_fn: Callable, stage_params: PyTree, x: jax.Array,
     # broadcast from the last stage so every device returns the result
     from distlearn_tpu.parallel.mesh import broadcast_from
     return broadcast_from(y, S - 1, axis_name)
+
+
+def pipeline_1f1b(stage_fn: Callable, stage_params: PyTree,
+                  consume_fn: Callable, consume_params: PyTree,
+                  x: jax.Array, num_microbatches: int,
+                  axis_name: str = "pipe"):
+    """One-forward-one-backward pipeline schedule, gradients included.
+
+    :func:`pipeline_apply` + ``jax.grad`` IS GPipe: all M forwards run
+    before any backward, so the autodiff residuals of every in-flight
+    microbatch stay live — activation memory O(M).  This function runs
+    the 1F1B schedule instead: each microbatch's backward starts as soon
+    as it leaves the last stage, so at most ``2(S-1)+1`` microbatch
+    INPUTS are ever held per stage — activation memory O(S), the reason
+    1F1B is the production schedule when M >> S.  The price: gradients
+    are computed manually (``jax.vjp`` per tick) rather than by
+    differentiating through the forward scan, so this function RETURNS
+    gradients and cannot itself sit under ``jax.grad``.
+
+    Schedule (SPMD — every rank runs the same T-tick scan, masked by its
+    ``axis_name`` index): tick ``t`` runs the GPipe forward for
+    microbatch ``t - idx`` AND the backward for microbatch
+    ``t - 2(S-1) + idx``; the last stage seeds its own cotangent from
+    ``consume_fn``'s vjp in the same tick its forward emerges, and
+    cotangents ride a backward neighbor ppermute.  Total ticks
+    ``T = M + 2S - 2`` (vs GPipe's ``M + S - 1`` forward ticks plus the
+    reversed backward scan — same compute, same bubble fraction).  The
+    per-tick backward re-runs the stage forward inside ``jax.vjp``
+    (recompute-from-stage-input), matching the memory/FLOP trade of
+    ``remat=True`` GPipe.
+
+    Args:
+      stage_fn: ``(stage_params, h) -> h`` — shape-preserving, as in
+        :func:`pipeline_apply`.
+      consume_fn: ``(consume_params, out_mb, mb_index) -> scalar`` — the
+        last-stage loss share (e.g. this microbatch's share of the
+        global-mean NLL).  Unlike :func:`pipeline_apply`'s ``consume_fn``
+        it takes its parameters EXPLICITLY, because their gradient must
+        be returned (a closure would silently drop it).
+      consume_params: pytree of parameters consumed by ``consume_fn``.
+      x: ``[B, ...]`` input ACTIVATIONS (already embedded), replicated
+        over the pipe axis.
+      num_microbatches: M; ``B`` must divide evenly.
+
+    Returns ``(local_share, g_stage_params, g_consume_params, g_x)``:
+    the loss share (nonzero only on the last rank — psum it), this
+    stage's parameter gradients, ``consume_fn``'s parameter gradients
+    (nonzero only on the last rank — psum over pipe reassembles), and
+    the gradient w.r.t. ``x`` (nonzero only on rank 0; backprop it
+    through the embedding outside).
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    mb = B // M
+    mbs = x.reshape((M, mb) + x.shape[1:])
+    T = M + 2 * S - 2
+    BUF = 2 * S - 1            # max in-flight saved inputs per stage
+
+    out_aval = jax.eval_shape(stage_fn, stage_params, mbs[0])
+    if out_aval.shape != mbs[0].shape:
+        raise ValueError(
+            f"stage_fn must preserve activation shape (got {mbs[0].shape} "
+            f"-> {out_aval.shape})")
+    act_dtype = out_aval.dtype
+    zeros_act = jnp.zeros(out_aval.shape, act_dtype)
+
+    fwd_perm = [(j, j + 1) for j in range(S - 1)]
+    bwd_perm = [(j, j - 1) for j in range(1, S)]
+    zf32 = jnp.zeros((), jnp.float32)
+
+    def tick(carry, t):
+        fwd_in, buf, cot_in, g_stage, g_cons, gx, share = carry
+
+        # ---- forward half: GPipe ingest + stage forward -------------------
+        m_f = t - idx                      # this stage's fwd microbatch
+        fwd_valid = (m_f >= 0) & (m_f < M)
+        feed = lax.dynamic_index_in_dim(mbs, jnp.clip(m_f, 0, M - 1), 0,
+                                        keepdims=False)
+        a_in = jnp.where(idx == 0, feed.astype(act_dtype), fwd_in)
+        out = stage_fn(stage_params, a_in)
+        buf = lax.dynamic_update_index_in_dim(buf, a_in, t % BUF, 0)
+
+        # last stage: fold the loss share and seed the cotangent for this
+        # SAME microbatch's backward, which runs this very tick
+        def cons(cp, o):
+            return consume_fn(cp, o, jnp.clip(m_f, 0, M - 1))
+
+        val, cvjp = jax.vjp(cons, consume_params, out)
+        g_cp_t, seed = cvjp(jnp.ones((), val.dtype))
+        last_live = (idx == S - 1) & fwd_valid
+        share = share + jnp.where(last_live, val.astype(jnp.float32), zf32)
+        g_cons = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(last_live, g, jnp.zeros_like(g)),
+            g_cons, g_cp_t)
+
+        # ---- backward half: 1F1B interleave -------------------------------
+        m_b = t - (2 * S - 2) + idx        # this stage's bwd microbatch
+        bwd_valid = (m_b >= 0) & (m_b < M)
+        cot = jnp.where(idx == S - 1, seed.astype(act_dtype),
+                        cot_in.astype(act_dtype))
+        # its input was saved at tick m_b + idx
+        slot = jnp.clip(m_b + idx, 0, T - 1) % BUF
+        a_saved = lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+        _, svjp = jax.vjp(stage_fn, stage_params, a_saved)
+        g_p_t, g_in = svjp(cot)
+        g_stage = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(bwd_valid, g, jnp.zeros_like(g)),
+            g_stage, g_p_t)
+        # rank 0's input-gradient is the embedding cotangent for mb m_b
+        gx_upd = lax.dynamic_update_index_in_dim(
+            gx, g_in.astype(gx.dtype), jnp.clip(m_b, 0, M - 1), 0)
+        gx = jnp.where((idx == 0) & bwd_valid, gx_upd, gx)
+
+        # ---- neighbor exchanges for the next tick -------------------------
+        fwd_nxt = lax.ppermute(out, axis_name, fwd_perm)
+        cot_nxt = lax.ppermute(g_in, axis_name, bwd_perm)
+        return (fwd_nxt, buf, cot_nxt, g_stage, g_cons, gx, share), None
+
+    init = (zeros_act,
+            jnp.zeros((BUF,) + out_aval.shape, act_dtype),
+            zeros_act,
+            jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+            jax.tree_util.tree_map(jnp.zeros_like, consume_params),
+            jnp.zeros(mbs.shape, x.dtype),
+            zf32)
+    (_, _, _, g_stage, g_cons, gx, share), _ = lax.scan(
+        tick, init, jnp.arange(T))
+    return share, g_stage, g_cons, gx.reshape((B,) + x.shape[1:])

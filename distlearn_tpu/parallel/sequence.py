@@ -49,21 +49,52 @@ def _block_attn(q, k, v, scale, mask):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str, causal: bool = False,
-                   impl: str | None = None) -> jax.Array:
+                   impl: str | None = None,
+                   layout: str = "contig",
+                   unroll: bool | int = False) -> jax.Array:
     """Blockwise ring attention.
 
     Args:
       q, k, v: local shards ``[B, L_local, H, D]`` — the global sequence is
-        the concatenation over the mesh axis in rank order.
+        the concatenation over the mesh axis in rank order (``layout=
+        "contig"``), or the :func:`zigzag_indices` permutation of it
+        (``layout="zigzag"``).
       axis_name: mesh axis carrying the sequence shards.
       causal: apply a causal mask over GLOBAL positions.
-      impl: single-device kernel choice, forwarded to
-        :func:`local_attention` when the axis has size 1 (the blockwise
-        ring math takes over for n > 1).
+      impl: single-device kernel choice, honored ONLY in the degenerate
+        n == 1 case (forwarded to :func:`local_attention`).  For n > 1
+        the inner kernel is always the portable blockwise
+        :func:`_block_attn` — the Pallas flash kernel in this jax
+        version returns no softmax residuals, so its per-block outputs
+        cannot be merged across ring hops; use the zigzag layout to
+        halve the causal block work, and note its per-block score
+        buffer is [B, H, L_loc/2, L_loc/2] (a quarter of the contiguous
+        ring's per-block buffer).
+      unroll: forwarded to the ring ``fori_loop`` — inlining the n-1
+        hops lets XLA overlap each hop's ppermute with the next block's
+        compute across iteration boundaries (the r3 GPipe lesson; use
+        for small n).
+      layout: ``"zigzag"`` + ``causal`` runs the balanced schedule that
+        never computes fully-masked blocks (~2x FLOP cut at large n, and
+        identical load on every rank — the contiguous causal ring makes
+        every rank wait for rank n-1's n-blocks-of-work).  Non-causal
+        attention is permutation-equivariant, so zigzag data needs no
+        special handling there (the standard ring is already correct).
 
-    Returns: local attention output ``[B, L_local, H, D]`` (q's dtype).
+    Returns: local attention output ``[B, L_local, H, D]`` (q's dtype),
+    in the same layout as the inputs.
     """
+    if layout not in ("contig", "zigzag"):
+        raise ValueError(f"layout must be 'contig' or 'zigzag', "
+                         f"got {layout!r}")
     n = lax.axis_size(axis_name)
+    if layout == "zigzag" and causal and n > 1:
+        if q.shape[1] % 2:
+            raise ValueError(
+                f"zigzag layout needs an even local length (two stripes "
+                f"per rank), got {q.shape[1]}")
+        return _zigzag_ring_causal(q, k, v, axis_name, n,
+                                   lax.axis_index(axis_name), unroll=unroll)
     if n == 1:
         # Degenerate ring: the whole sequence is local.  Delegate to the
         # single-device kernel so the flash/chunked paths (no O(L^2)
@@ -100,8 +131,110 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m0 = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Lq), jnp.float32)
     o0 = jnp.zeros((B, H, Lq, D), jnp.float32)
-    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0),
+                                  unroll=unroll)
     out = o / jnp.maximum(l, 1e-30)[..., None]            # [B,H,Lq,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def zigzag_indices(n: int, L: int):
+    """Global-position permutation for the zigzag sequence layout.
+
+    With ``n`` ranks the global sequence splits into ``2n`` equal stripes;
+    rank ``r`` holds stripes ``r`` and ``2n-1-r`` concatenated.  Returns an
+    int array ``idx`` of length ``L`` such that ``x_zigzag = x[..., idx]``
+    produces the layout whose rank-order contiguous shards are the zigzag
+    shards (i.e. shard it with the same ``P(..., seq_axis)`` spec as the
+    contiguous layout).  Invert with ``jnp.argsort(idx)``.
+
+    Why: under a CAUSAL mask the contiguous layout is pathologically
+    imbalanced — rank 0's queries see almost no keys while rank n-1's see
+    all of them, and every rank pays the worst rank's wall clock.  Pairing
+    an early stripe with its mirror-image late stripe gives every rank an
+    identical two-full-blocks-per-hop schedule (see
+    :func:`ring_attention` ``layout="zigzag"``).
+    """
+    import numpy as np
+    if L % (2 * n):
+        raise ValueError(f"sequence length {L} must divide into 2*n={2*n} "
+                         "equal zigzag stripes")
+    s = L // (2 * n)
+    idx = []
+    for r in range(n):
+        idx.extend(range(r * s, (r + 1) * s))
+        idx.extend(range((2 * n - 1 - r) * s, (2 * n - r) * s))
+    return np.asarray(idx, np.int32)
+
+
+def _merge_blocks(acc, blk):
+    """Online-softmax merge of two blockwise partial results
+    ``(m [B,H,Lq], l [B,H,Lq], o [B,H,Lq,D])``."""
+    m, l, o = acc
+    mb, lb, ob = blk
+    m_new = jnp.maximum(m, mb)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(mb - m_new)
+    return (m_new, l * alpha + lb * beta,
+            o * alpha[..., None] + ob * beta[..., None])
+
+
+def _zigzag_ring_causal(q, k, v, axis_name, n, my, unroll=False):
+    """Causal ring attention on the zigzag layout (local shard = early
+    stripe ``a=my`` ++ late stripe ``b=2n-1-my``).
+
+    Per ring hop the work is exactly two UNMASKED stripe blocks on every
+    rank: ``qb×k_early(src)`` always (the late stripe sees every early
+    stripe), plus ``qa×k_early(src)`` when ``src < my`` or
+    ``qb×k_late(src)`` when ``src > my`` — one of the two, never both, so
+    the load is identical on all ranks and the fully-masked blocks the
+    contiguous layout wastes ~half its FLOPs computing are never
+    launched.  Hop 0 handles the two in-stripe causal diagonals plus the
+    local ``qb×ka`` block."""
+    B, L2, H, D = q.shape
+    s = L2 // 2
+    scale = 1.0 / (D ** 0.5)
+    tri = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+
+    qa, qb = q[:, :s], q[:, s:]
+    ka, kb = k[:, :s], k[:, s:]
+    va, vb = v[:, :s], v[:, s:]
+
+    # hop 0: local blocks
+    acc_a = _block_attn(qa, ka, va, scale, tri)              # diagonal of a
+    acc_b = _merge_blocks(_block_attn(qb, ka, va, scale, None),   # full
+                          _block_attn(qb, kb, vb, scale, tri))    # diagonal
+
+    def body(i, carry):
+        kc, vc, kd, vd, acc_a, acc_b = carry
+        src = (my - i) % n
+        # unconditional: late queries attend src's early stripe
+        acc_b = _merge_blocks(acc_b, _block_attn(qb, kc, vc, scale, None))
+        # one conditional full block — same shape either way, so select
+        # the operands and then select which accumulator takes the result
+        pred = src < my
+        q_sel = jnp.where(pred, qa, qb)
+        k_sel = jnp.where(pred, kc, kd)
+        v_sel = jnp.where(pred, vc, vd)
+        blk = _block_attn(q_sel, k_sel, v_sel, scale, None)
+        new_a = _merge_blocks(acc_a, blk)
+        new_b = _merge_blocks(acc_b, blk)
+        acc_a = jax.tree_util.tree_map(
+            lambda nw, old: jnp.where(pred, nw, old), new_a, acc_a)
+        acc_b = jax.tree_util.tree_map(
+            lambda old, nw: jnp.where(pred, old, nw), acc_b, new_b)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        rot = lambda t: lax.ppermute(t, axis_name, perm)   # noqa: E731
+        return rot(kc), rot(vc), rot(kd), rot(vd), acc_a, acc_b
+
+    init = (*(lax.ppermute(t, axis_name, [(j, (j + 1) % n) for j in range(n)])
+              for t in (ka, va, kb, vb)), acc_a, acc_b)
+    *_, acc_a, acc_b = lax.fori_loop(1, n, body, init, unroll=unroll)
+
+    def finish(acc):
+        m, l, o = acc
+        return o / jnp.maximum(l, 1e-30)[..., None]        # [B,H,s,D]
+
+    out = jnp.concatenate([finish(acc_a), finish(acc_b)], axis=2)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 

@@ -9,6 +9,7 @@ from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          build_ea_cycle, reduce_confusion)
 from distlearn_tpu.train.lm import (LMEAState, build_lm_ea_steps,
                                     build_lm_moe_metrics,
+                                    build_lm_pp_1f1b_step,
                                     build_lm_pp_step, build_lm_step,
                                     init_lm_ea_state, stack_blocks,
                                     unstack_blocks)
@@ -28,7 +29,8 @@ __all__ = [
     "build_sgd_step", "build_sgd_scan_step", "build_sync_step",
     "build_eval_step", "build_ea_steps", "build_ea_cycle",
     "reduce_confusion", "build_lm_step", "build_lm_moe_metrics",
-    "build_lm_pp_step", "stack_blocks", "unstack_blocks",
+    "build_lm_pp_step", "build_lm_pp_1f1b_step", "stack_blocks",
+    "unstack_blocks",
     "LMEAState", "build_lm_ea_steps", "init_lm_ea_state",
     "OptaxTrainState", "build_optax_step", "init_optax_state",
     "ZeroTrainState", "build_zero_optax_step", "init_zero_state",

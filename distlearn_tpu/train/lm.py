@@ -21,7 +21,8 @@ from distlearn_tpu.parallel.pp import pipeline_apply
 
 def lm_local_grads(model: Model, params, tokens, *, seq_axis, tp_axis,
                    ep_axis=None, accum_steps: int = 1,
-                   moe_balance_weight: float = 0.0):
+                   moe_balance_weight: float = 0.0,
+                   seq_layout: str = "contig"):
     """``(local_loss_share, grads)`` of the LM objective on THIS device's
     shard — the gradient machinery shared by every LM step builder
     (:func:`build_lm_step`, ``optim.build_lm_optax_step``).
@@ -36,7 +37,8 @@ def lm_local_grads(model: Model, params, tokens, *, seq_axis, tp_axis,
             lambda p: lm_loss(model, p, toks, seq_axis=seq_axis,
                               tp_axis=tp_axis, ep_axis=ep_axis,
                               reduce=False,
-                              moe_balance_weight=moe_balance_weight)
+                              moe_balance_weight=moe_balance_weight,
+                              seq_layout=seq_layout)
             )(params)
 
     if accum_steps == 1:
@@ -68,7 +70,8 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
                   moe_balance_weight: float = 0.0,
                   fused: bool | None = None,
                   max_bucket_bytes: int | None = None,
-                  donate: bool = True) -> Callable:
+                  donate: bool = True,
+                  seq_layout: str = "contig") -> Callable:
     """``step(params, tokens) -> (params, loss)``.
 
     ``tokens``: [global_B, global_L] int32, sharded (data, seq).
@@ -125,7 +128,7 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
         local_loss, grads = lm_local_grads(
             model, params, tokens, seq_axis=seq_axis, tp_axis=tp_axis,
             ep_axis=ep_axis, accum_steps=accum_steps,
-            moe_balance_weight=moe_balance_weight)
+            moe_balance_weight=moe_balance_weight, seq_layout=seq_layout)
         loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
         # Sum partial grads over seq (params replicated there, each shard
         # holds part of the chain) and AVERAGE over data (the global
@@ -308,6 +311,110 @@ def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,
         # shared leaves: partial grads live on the pipe ranks that touched
         # them — SUM over pipe reassembles; average over data (1/n as in
         # allreduce_sgd)
+        g_shared = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, (data_axis, pipe_axis))
+            / jnp.asarray(dp, g.dtype), g_shared)
+        g_blk = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, data_axis) / jnp.asarray(dp, g.dtype),
+            g_blk)
+        shared = jax.tree_util.tree_map(
+            lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+            shared, g_shared)
+        stacked_new = jax.tree_util.tree_map(
+            lambda p, g: p - jnp.asarray(lr, p.dtype) * g.astype(p.dtype),
+            stacked, g_blk)
+        return shared, stacked_new, lax.pmean(loss, data_axis)
+
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(pipe_axis), P(data_axis)),
+        out_specs=(P(), P(pipe_axis), P()),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def build_lm_pp_1f1b_step(mesh: Mesh, shared_template, stacked_template,
+                          lr: float, num_microbatches: int,
+                          compute_dtype=None, data_axis: str = "data",
+                          pipe_axis: str = "pipe", remat: bool = False,
+                          donate: bool = True) -> Callable:
+    """1F1B-scheduled pipeline-parallel LM train step — same contract,
+    sharding, and gradient semantics as :func:`build_lm_pp_step`
+    (``step(shared, stacked, tokens) -> (shared, stacked, loss)``), but
+    each microbatch's backward starts the moment it leaves the last
+    stage (:func:`distlearn_tpu.parallel.pp.pipeline_1f1b`), so live
+    activation memory is O(S) stage-inputs instead of GPipe's O(M)
+    autodiff residuals — the schedule to use when the microbatch count
+    is cranked up for bubble amortization.  ``remat`` checkpoints each
+    block inside the stage fn (the per-tick backward already recomputes
+    the stage forward from its input; block-level remat additionally
+    bounds the recompute graph's own liveness for k-block stages).
+
+    Embedding/positional gradients flow through the returned ``g_x``
+    (rank 0), head/out-norm gradients through the explicit consume
+    params (last rank); both reassemble with the same pipe-axis psum as
+    the GPipe builder, so the two schedules are drop-in interchangeable
+    (equivalence is tested).
+    """
+    from distlearn_tpu.parallel.pp import pipeline_1f1b
+    n_stages = mesh.shape[pipe_axis]
+    depth = jax.tree_util.tree_leaves(stacked_template)[0].shape[0]
+    if depth % n_stages:
+        raise ValueError(
+            f"stacked blocks hold {depth} layers but the {pipe_axis!r} "
+            f"axis has {n_stages} devices — depth must divide into an "
+            "equal number of blocks per stage")
+    for need in ("embed", "pos", "out_norm"):
+        if need not in shared_template:
+            raise ValueError(f"shared params missing {need!r} — pass the "
+                             "(shared, stacked) pair from stack_blocks()")
+
+    def step(shared, stacked, tokens):
+        B, L = tokens.shape
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"per-replica batch {B} not divisible into "
+                             f"{M} microbatches")
+        toks_mb = tokens.reshape(M, B // M, L)
+        cd = compute_dtype or shared["embed"].dtype
+
+        def embed_fn(sh):
+            x = sh["embed"][tokens].astype(cd)
+            return x + sh["pos"][:L].astype(cd)[None]
+
+        x, embed_vjp = jax.vjp(embed_fn,
+                               {"embed": shared["embed"],
+                                "pos": shared["pos"]})
+
+        one = lambda bp, h: block_apply(bp, h, cd)   # noqa: E731
+        if remat:
+            one = jax.checkpoint(one)
+
+        def stage(bp_stack, h):
+            h, _ = lax.scan(lambda hh, bp: (one(bp, hh), None), h, bp_stack)
+            return h
+
+        def consume(cp, out_mb, m):
+            hh = _rmsnorm(cp["out_norm"], out_mb)
+            logits = (hh @ cp["embed"].T.astype(cd)).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits[:, :-1])
+            tgt = lax.dynamic_index_in_dim(toks_mb, m, 0,
+                                           keepdims=False)[:, 1:]
+            nll = -jnp.take_along_axis(lp, tgt[..., None], -1)[..., 0]
+            return nll.sum() / jnp.float32(B * (L - 1))
+
+        cp = {"out_norm": shared["out_norm"], "embed": shared["embed"]}
+        local_share, g_blk, g_cp, g_x = pipeline_1f1b(
+            stage, stacked, consume, cp, x, M, axis_name=pipe_axis)
+        (g_embed,) = embed_vjp(g_x.astype(x.dtype))
+
+        loss = lax.psum(local_share, pipe_axis)
+        dp = lax.psum(1, data_axis)
+        # reassemble shared grads: embedding side (rank 0) + head side
+        # (last rank); embed appears in both
+        g_shared = {"embed": g_embed["embed"] + g_cp["embed"],
+                    "pos": g_embed["pos"],
+                    "out_norm": g_cp["out_norm"]}
         g_shared = jax.tree_util.tree_map(
             lambda g: lax.psum(g, (data_axis, pipe_axis))
             / jnp.asarray(dp, g.dtype), g_shared)

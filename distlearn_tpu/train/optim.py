@@ -326,7 +326,8 @@ def build_lm_optax_step(model: Model, mesh, tx,
                         seq_axis: str | None = "seq",
                         accum_steps: int = 1,
                         moe_balance_weight: float = 0.0,
-                        donate: bool = True) -> Callable:
+                        donate: bool = True,
+                        seq_layout: str = "contig") -> Callable:
     """Any optax optimizer on the transformer-LM family over a
     ``(data, seq)`` mesh: ``step(st, tokens) -> (st, loss)`` with
     ``st = LMOptaxState(params, opt_state)``, both replicated (every
@@ -352,7 +353,7 @@ def build_lm_optax_step(model: Model, mesh, tx,
         local_loss, grads = lm_local_grads(
             model, st.params, tokens, seq_axis=seq_axis, tp_axis=None,
             accum_steps=accum_steps,
-            moe_balance_weight=moe_balance_weight)
+            moe_balance_weight=moe_balance_weight, seq_layout=seq_layout)
         loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
         dp = lax.psum(1, data_axis)
         grads = jax.tree_util.tree_map(

@@ -438,7 +438,9 @@ def test_concurrent_server_evicts_dead_client_others_continue():
     import time
     t0 = time.time()
     while srv.syncs_completed < 3:
-        assert time.time() - t0 < 30.0, srv.syncs_completed
+        # generous: observed flaking at 30s when the full suite saturates
+        # the 1-core host; solo it completes in well under a second
+        assert time.time() - t0 < 90.0, srv.syncs_completed
         time.sleep(0.02)
     t1.join(timeout=20.0)
     t2.join(timeout=20.0)
@@ -543,3 +545,33 @@ def test_client_wide_dtype_params_interop():
     assert srv.center[0].dtype == np.float32
     np.testing.assert_allclose(srv.center[0], 1.0)   # (2-0)*0.5 applied
     np.testing.assert_allclose(out["p"]["w"], 1.0)   # p -= delta
+
+
+def test_concurrent_server_serial_api_still_works():
+    """The concurrent server's center is immutable-published (read-only
+    leaves); the inherited serial sync_server() must route its apply
+    through the same publish path instead of mutating frozen arrays."""
+    from distlearn_tpu.parallel.async_ea import AsyncEAServerConcurrent
+    port = _ports()
+    out = {}
+
+    def client():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        p = c.init_client({"w": np.zeros(8, np.float32)})
+        p = {"w": p["w"] + np.float32(2.0)}
+        p, synced = c.sync_client(p)
+        out["synced"] = synced
+        c.close()
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=1,
+                                  accept_timeout=60.0)
+    srv.init_server({"w": np.zeros(8, np.float32)})
+    # serial API on the concurrent class — no start()/worker threads
+    got = srv.sync_server({"w": np.zeros(8, np.float32)})
+    t.join(timeout=10.0)
+    assert out["synced"]
+    np.testing.assert_allclose(got["w"], 1.0)
+    assert srv.syncs_completed == 1
+    srv.close()

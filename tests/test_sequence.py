@@ -260,3 +260,122 @@ def test_local_attention_chunked_impl_dispatch():
     ref = local_attention(q, k, v, causal=True, impl="xla")
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
+
+
+# --- zigzag causal ring attention (balanced layout, masked-block skip) ------
+
+
+def _zigzag(mesh, n, unroll=False):
+    from distlearn_tpu.parallel.sequence import ring_attention
+    return jax.jit(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "seq", causal=True,
+                                       layout="zigzag", unroll=unroll),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+
+
+def test_zigzag_causal_matches_local():
+    """Zigzag-laid-out causal ring == the full-attention oracle, after
+    undoing the layout permutation (both 4 and 8 ranks: even/odd
+    src-vs-my branches both exercised)."""
+    from distlearn_tpu.parallel.sequence import zigzag_indices
+    q, k, v = _qkv(7)
+    for n in (4, 8):
+        mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+        idx = zigzag_indices(n, L)
+        inv = np.argsort(idx)
+        out = _zigzag(mesh, n)(q[:, idx], k[:, idx], v[:, idx])[:, inv]
+        ref = local_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_causal_gradients_match():
+    from distlearn_tpu.parallel.sequence import zigzag_indices
+    q, k, v = _qkv(8)
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+    idx = zigzag_indices(n, L)
+    inv = np.argsort(idx)
+    zz = _zigzag(mesh, n)
+
+    def loss_z(a, b, c):
+        return jnp.sum(zz(a[:, idx], b[:, idx], c[:, idx])[:, inv] ** 2)
+
+    def loss_l(a, b, c):
+        return jnp.sum(local_attention(a, b, c, causal=True) ** 2)
+
+    gz = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+    gl = jax.grad(loss_l, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gz, gl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_zigzag_halves_causal_flops():
+    """The point of the layout: fully-masked blocks are never computed.
+    Unrolled (so XLA's cost model counts every hop), the zigzag program's
+    flops must be ~(2n+1)/(4n) of the contiguous causal ring's — about
+    0.56 at n=4 — not merely 'a bit less'."""
+    from distlearn_tpu.parallel.sequence import ring_attention
+    # longer sequence than the shared fixture so the s^2 attention terms
+    # dominate the per-hop softmax-stat overhead (at s=4 the overhead
+    # hides the cut; the claim is about the quadratic terms)
+    rng = np.random.RandomState(9)
+    mk = lambda: jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+
+    def build(layout):
+        return jax.jit(jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "seq", causal=True,
+                                           layout=layout, unroll=True),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False))
+
+    fz = build("zigzag").lower(q, k, v).compile().cost_analysis()["flops"]
+    fc = build("contig").lower(q, k, v).compile().cost_analysis()["flops"]
+    assert fz / fc < 0.65, f"zigzag/contig flops = {fz/fc:.3f}"
+
+
+def test_zigzag_indices_roundtrip_and_validation():
+    from distlearn_tpu.parallel.sequence import zigzag_indices
+    idx = zigzag_indices(4, 32)
+    assert sorted(idx.tolist()) == list(range(32))
+    # rank 0 holds stripes 0 and 7 (s=4): [0..3, 28..31]
+    assert idx[:8].tolist() == [0, 1, 2, 3, 28, 29, 30, 31]
+    with pytest.raises(ValueError, match="stripes"):
+        zigzag_indices(4, 30)
+
+
+def test_ring_layout_validation():
+    from distlearn_tpu.parallel.sequence import ring_attention
+    q, k, v = _qkv(10)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    with pytest.raises(ValueError, match="layout"):
+        jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, "seq", layout="spiral"),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False)(q, k, v)
+
+
+def test_zigzag_noncausal_is_plain_ring():
+    """Non-causal attention is permutation-equivariant: zigzag-ordered
+    data through the standard ring already gives the right answer, so
+    layout='zigzag' without causal must not change the math."""
+    from distlearn_tpu.parallel.sequence import ring_attention, zigzag_indices
+    q, k, v = _qkv(11)
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+    idx = zigzag_indices(n, L)
+    inv = np.argsort(idx)
+    out = jax.jit(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "seq", causal=False,
+                                       layout="zigzag"),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))(
+            q[:, idx], k[:, idx], v[:, idx])[:, inv]
+    ref = local_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
