@@ -558,7 +558,7 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
     nelem = size_mb * 1024 * 1024 // 4
     payload = nelem * 4
 
-    def run_once(make):
+    def run_once_iters(make, k):
         port = _port()
 
         def node(rank):
@@ -567,28 +567,90 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
             h.all_reduce(x)         # warmup
             h.barrier()
             t0 = _t.perf_counter()
-            for _ in range(iters):
+            for _ in range(k):
                 h.all_reduce(x)
             dt = _t.perf_counter() - t0
             h.close()
             return dt
         times = tree_map_spawn(node, n, timeout=600)
-        return max(times) / iters     # collective ends when slowest ends
+        return max(times) / k         # collective ends when slowest ends
+
+    def run_once(make):
+        return run_once_iters(make, iters)
 
     def run(make, reps: int = 3):
         # localhost on a shared CPU is noisy (observed 0.8-1.5x run-to-run):
         # take the median of independent topologies
         return statistics.median(run_once(make) for _ in range(reps))
 
+    def _conns(h):
+        if hasattr(h, "_succ"):          # Ring: successor + predecessor
+            return [c for c in (h._succ, h._pred) if c is not None]
+        return ([h._parent] if h._parent else []) + list(h._kids)   # Tree
+
+    def _throttled(make, bps):
+        def mk(rank, port):
+            h = make(rank, port)
+            for c in _conns(h):
+                c.throttle_bps = bps
+            return h
+        return mk
+
+    def max_nic_bytes(make):
+        """One allreduce; the busiest HOST's total wire traffic (sent +
+        received over every one of that rank's connections) — the per-NIC
+        contention the bandwidth claims are about, MEASURED.  Base-2 tree
+        root: 2 children x payload up and down = ~4T; ring rank: 
+        2T(N-1)/N out + the same in = ~3T at N=4, -> 2T as N grows."""
+        port = _port()
+
+        def node(rank):
+            h = make(rank, port)
+            x = np.random.RandomState(rank).randn(nelem).astype(np.float32)
+            base = sum(c.bytes_sent + c.bytes_received for c in _conns(h))
+            h.all_reduce(x)
+            got = sum(c.bytes_sent + c.bytes_received
+                      for c in _conns(h)) - base
+            h.close()
+            return got
+        return max(tree_map_spawn(node, n, timeout=600))
+
     t_tree = run(lambda r, p: LocalhostTree(r, n, p, base=2))
     t_ring = run(lambda r, p: LocalhostRing(r, n, p))
     bus = lambda t: (2 * (n - 1) / n) * payload / t / 1e9  # noqa: E731
-    return {
+    out = {
         "devices": n, "payload_mb": size_mb,
         "tree_sec": t_tree, "ring_sec": t_ring,
         "tree_busbw_gb_s": bus(t_tree), "ring_busbw_gb_s": bus(t_ring),
         "ring_speedup": t_tree / t_ring,
+        # measured per-NIC traffic (the structural claim, independent of
+        # this host's shared-CPU wall clock)
+        "tree_max_nic_bytes": max_nic_bytes(
+            lambda r, p: LocalhostTree(r, n, p, base=2)),
+        "ring_max_nic_bytes": max_nic_bytes(
+            lambda r, p: LocalhostRing(r, n, p)),
+        "payload_bytes": payload,
     }
+    # Bandwidth-limited emulation: pace every link to a fixed bytes/sec
+    # (slow enough that the shared CPU is NOT the bottleneck).  This is
+    # the regime the ring is for — real per-host NICs — and where its
+    # 2T(N-1)/N per-link traffic beats the tree's root hotspot; on the
+    # unthrottled loopback above both backends move the same TOTAL bytes
+    # through one CPU, so the tree's fewer rounds win instead.
+    bps = float(os.environ.get("BENCH_HOST_EMULATED_LINK_MB_S",
+                               "200")) * 1e6
+    emu_iters = 2
+    t_tree_e = run_once_iters(
+        _throttled(lambda r, p: LocalhostTree(r, n, p, base=2), bps),
+        emu_iters)
+    t_ring_e = run_once_iters(
+        _throttled(lambda r, p: LocalhostRing(r, n, p), bps), emu_iters)
+    out.update({
+        "emulated_link_mb_s": bps / 1e6,
+        "tree_sec_emulated": t_tree_e, "ring_sec_emulated": t_ring_e,
+        "ring_speedup_emulated": t_tree_e / t_ring_e,
+    })
+    return out
 
 
 def async_ea_bench(param_mb: int = 8, n_clients: int = 2,
@@ -679,9 +741,13 @@ def async_ea_bench(param_mb: int = 8, n_clients: int = 2,
     }
 
 
-def bench_resnet50(batch: int, iters: int, windows: int, peak):
+def bench_resnet50(batch: int, iters: int, windows: int, peak,
+                   norm: str = "batch"):
     """ResNet-50/ImageNet-shape utilization bench (the model where MFU is
-    meaningful — BASELINE.md stretch config)."""
+    meaningful — BASELINE.md stretch config).  ``norm="none"`` benches the
+    SkipInit norm-free variant — the r3 profile put ~50% of the BN
+    model's step time in channel-statistics reductions, so the delta
+    between the two rows IS the measured BN bandwidth cost."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -696,7 +762,8 @@ def bench_resnet50(batch: int, iters: int, windows: int, peak):
     tree = MeshTree(num_nodes=n_dev)
     platform = jax.devices()[0].platform
     model = resnet50(
-        compute_dtype=jnp.bfloat16 if platform == "tpu" else None)
+        compute_dtype=jnp.bfloat16 if platform == "tpu" else None,
+        norm=norm)
     ts = init_train_state(model, tree, random.PRNGKey(0), 1000)
     step = build_sgd_step(model, tree, lr=0.1)
     rs = np.random.RandomState(0)
@@ -710,7 +777,8 @@ def bench_resnet50(batch: int, iters: int, windows: int, peak):
                                      warmup=5)
     mfu = check_mfu("resnet50", flops, sps, peak)
     return {
-        "batch": batch, "steps_per_sec": sps, "images_per_sec": sps * batch,
+        "batch": batch, "norm": norm, "steps_per_sec": sps,
+        "images_per_sec": sps * batch,
         "flops_per_step": flops, "mfu": mfu, "window_times": times,
         "final_loss": loss,
     }
@@ -718,16 +786,22 @@ def bench_resnet50(batch: int, iters: int, windows: int, peak):
 
 def bench_transformer_lm(batch: int, seq: int, iters: int, windows: int,
                          peak, attn: str | None = None,
-                         remat: bool | str = False):
+                         remat: bool | str = False,
+                         scan_blocks: bool = False):
     """Long-context transformer LM utilization bench: the fused LM train
     step (next-token loss, full backward, SGD) on one chip, bf16 compute.
     On a pod the same step shards over (data, seq, model) axes — see
     distlearn_tpu.train.lm; this measures the per-chip compute story.
     ``attn`` picks the attention kernel ("xla"/"flash"/"chunked" — see
     distlearn_tpu.parallel.sequence.local_attention); ``remat`` is the
-    transformer's mode (False / "full" / "mlp")."""
+    transformer's mode (False / "full" / "mlp"); ``scan_blocks`` uses the
+    scanned-depth layout (program size flat in depth — the recipe for
+    configs whose unrolled program exceeds the compile limits).  MFU for
+    scanned rows is analytic-only: XLA cost_analysis reports a scan
+    body's flops ONCE, so the compiled-program figure would undercount
+    by ~depth."""
     return _bench_transformer_lm(batch, seq, iters, windows, peak, attn,
-                                 remat)
+                                 remat, scan_blocks)
 
 
 def _lm_dim_depth():
@@ -741,7 +815,8 @@ def _lm_dim_depth():
     return dim, depth
 
 
-def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
+def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat,
+                          scan_blocks=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -757,7 +832,7 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
     dim, depth = _lm_dim_depth()
     lm = transformer_lm(vocab=32768, dim=dim, depth=depth, heads=dim // 64,
                         max_len=seq, compute_dtype=jnp.bfloat16, remat=remat,
-                        attn_impl=attn)
+                        attn_impl=attn, scan_blocks=scan_blocks)
     params, _ = lm.init(random.PRNGKey(0))
     step = build_lm_step(lm, mesh, params, lr=1e-2)
     tokens = jax.device_put(
@@ -765,14 +840,14 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
         .astype(np.int32),
         NamedSharding(mesh, P("data", "seq")))
 
-    flops = step_flops(step, params, tokens)
+    flops = None if scan_blocks else step_flops(step, params, tokens)
     # With remat, the executed program's flops INCLUDE activation recompute
     # — that ratio is HFU (hardware FLOPs utilization), not MFU.  The MFU
     # numerator is the MODEL's flops: lower (never execute — it would not
     # fit HBM) the same step without remat and take its cost_analysis, the
     # same convention every non-remat row uses.
     flops_model = flops
-    if remat and flops:
+    if remat and flops and not scan_blocks:
         lm_nr = transformer_lm(vocab=32768, dim=dim, depth=depth,
                                heads=dim // 64, max_len=seq,
                                compute_dtype=jnp.bfloat16, remat=False,
@@ -797,7 +872,8 @@ def _bench_transformer_lm(batch, seq, iters, windows, peak, attn, remat):
     mfu = check_mfu("transformer_lm", flops_model, sps, peak)
     return {
         "batch": batch, "seq_len": seq, "dim": dim, "depth": depth,
-        "attn": attn, "remat": remat, "steps_per_sec": sps,
+        "attn": attn, "remat": remat, "scan_blocks": scan_blocks,
+        "steps_per_sec": sps,
         "tokens_per_sec": sps * batch * seq, "flops_per_step": flops_model,
         "hw_flops_per_step": flops, "mfu": mfu,
         "hfu": hfu if remat else None,
@@ -1157,7 +1233,12 @@ def main():
                   f"{h['devices']} (localhost TCP): tree "
                   f"{h['tree_busbw_gb_s']:.2f} GB/s, ring "
                   f"{h['ring_busbw_gb_s']:.2f} GB/s "
-                  f"({h['ring_speedup']:.2f}x)", file=sys.stderr)
+                  f"({h['ring_speedup']:.2f}x shared-CPU; "
+                  f"{h['ring_speedup_emulated']:.2f}x on emulated "
+                  f"{h['emulated_link_mb_s']:.0f} MB/s links; busiest link "
+                  f"{h['ring_max_nic_bytes']/1e6:.1f} vs "
+                  f"{h['tree_max_nic_bytes']/1e6:.1f} MB)",
+                  file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"[bench] host allreduce bench failed: {e}",
                   file=sys.stderr)
@@ -1205,6 +1286,20 @@ def main():
                   f"{r['images_per_sec']:.0f} img/s"
                   + (f", MFU={r['mfu']:.4f}" if r["mfu"] is not None
                      else ""), file=sys.stderr)
+        # norm-free (SkipInit) variant: the delta vs the row above is the
+        # measured BN channel-reduction cost (~50% of step time per the
+        # r3 profile)
+        r2 = run_bench_section(
+            "resnet50_skipinit",
+            lambda: bench_resnet50(rb, ri, 3, peak, norm="none"))
+        if r2:
+            details["resnet50_skipinit"] = r2
+            sp = (f" ({r2['steps_per_sec'] / r['steps_per_sec']:.2f}x vs "
+                  "BN)" if r else "")
+            print(f"[bench] resnet50 skipinit batch={rb}: "
+                  f"{r2['images_per_sec']:.0f} img/s"
+                  + (f", MFU={r2['mfu']:.4f}" if r2["mfu"] is not None
+                     else "") + sp, file=sys.stderr)
 
     # --- transformer LM (long-context) utilization bench --------------------
     if os.environ.get("BENCH_SKIP_LM") != "1" and platform == "tpu":
@@ -1251,23 +1346,26 @@ def main():
 
     # --- long-context LM (chunked causal attention + selective remat) -------
     if os.environ.get("BENCH_SKIP_LM_LONG") != "1" and platform == "tpu":
-        # 16384 is absent: the attached tunnel's remote-compile helper
-        # dies (HTTP 500) on that program; the recipe itself is
-        # shape-generic — rerun with BENCH_LM_LONG_CFGS=1x16384 on a
-        # directly-attached chip.
+        # 1x16384 runs the scanned-depth layout ("s" suffix): the
+        # unrolled program at that length is what the attached tunnel's
+        # remote-compile helper rejects (HTTP 500).
         if ("BENCH_LM_LONG_BATCH" in os.environ
                 or "BENCH_LM_LONG_SEQ" in os.environ):
             # round-2 interface: honor the old single-config vars
             cfgs = (os.environ.get("BENCH_LM_LONG_BATCH", "1") + "x"
                     + os.environ.get("BENCH_LM_LONG_SEQ", "4096"))
         else:
+            # trailing "s" = scanned-depth layout (1x16384 only compiles
+            # scanned — the unrolled program exceeds the compile helper)
             cfgs = os.environ.get("BENCH_LM_LONG_CFGS",
-                                  "1x4096,1x8192,4x4096")
+                                  "1x4096,1x8192,4x4096,1x16384s")
         lci = int(os.environ.get("BENCH_LM_LONG_ITERS", "15"))
         lm_dim, lm_depth = _lm_dim_depth()
         rows = []
         for cfg in cfgs.split(","):
-            lcb, lcs = (int(v) for v in cfg.strip().split("x"))
+            cfg = cfg.strip()
+            scanned = cfg.endswith("s")
+            lcb, lcs = (int(v) for v in cfg.rstrip("s").split("x"))
             # Long-context recipe (r4): CHUNKED causal attention (masked
             # half of the scores never computed, softmax weights saved so
             # backward re-runs no exp — measured faster than both the
@@ -1279,8 +1377,10 @@ def main():
             remat_mode = "mlp" if w_bytes < 9e9 else "full"
             row = run_bench_section(
                 f"lm_long {cfg}",
-                lambda lcb=lcb, lcs=lcs, rm=remat_mode: bench_transformer_lm(
-                    lcb, lcs, lci, 3, peak, attn="chunked", remat=rm))
+                lambda lcb=lcb, lcs=lcs, rm=remat_mode, sc=scanned:
+                    bench_transformer_lm(lcb, lcs, lci, 3, peak,
+                                         attn="chunked", remat=rm,
+                                         scan_blocks=sc))
             if row:
                 rows.append(row)
         # Configs whose no-remat program the compile helper rejects have

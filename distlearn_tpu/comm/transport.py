@@ -35,12 +35,30 @@ _THDR = struct.Struct("<I")   # tensor header length
 
 
 class Conn:
-    """A framed connection over one TCP socket."""
+    """A framed connection over one TCP socket.
+
+    ``bytes_sent`` / ``bytes_received`` count payload bytes (frames +
+    tensors) — the per-link traffic evidence behind the tree-vs-ring
+    bandwidth analysis (docs/PERF.md).  ``throttle_bps`` (None = off)
+    paces SENDS to that many bytes/second: localhost benches use it to
+    emulate bandwidth-limited NIC links on a host whose loopback is
+    CPU-bound (the regime the ring allreduce is designed for), by
+    sleeping out the remainder of each send's wire-time budget."""
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._fd = sock.fileno()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.throttle_bps: float | None = None
+
+    def _pace(self, nbytes: int, t0: float):
+        if self.throttle_bps:
+            budget = nbytes / self.throttle_bps
+            left = budget - (time.perf_counter() - t0)
+            if left > 0:
+                time.sleep(left)
 
     def set_timeout(self, seconds: float | None):
         """Kernel-level send/recv timeout (SO_RCVTIMEO/SO_SNDTIMEO) so that a
@@ -59,6 +77,7 @@ class Conn:
 
     # -- low-level framing --------------------------------------------------
     def _send_frame(self, kind: int, payload: bytes | memoryview):
+        t0 = time.perf_counter()
         try:
             if native.available():
                 native.send_frame(self._fd, kind, payload)
@@ -67,12 +86,15 @@ class Conn:
                 self.sock.sendall(payload)
         except (BlockingIOError, InterruptedError) as e:
             raise TimeoutError("send timed out (socket timeout)") from e
+        self.bytes_sent += _HDR.size + len(payload)
+        self._pace(_HDR.size + len(payload), t0)
 
     def _recv_exact(self, n: int, out: memoryview | None = None) -> memoryview:
         buf = out if out is not None else memoryview(bytearray(n))
         try:
             if native.available():
                 native.recv_exact(self._fd, buf, n)
+                self.bytes_received += n
                 return buf
             got = 0
             while got < n:
@@ -82,6 +104,7 @@ class Conn:
                 got += r
         except BlockingIOError as e:   # SO_RCVTIMEO expired -> EAGAIN
             raise TimeoutError("recv timed out (socket timeout)") from e
+        self.bytes_received += n
         return buf
 
     def _recv_frame_header(self) -> tuple[int, int]:
@@ -106,16 +129,22 @@ class Conn:
         header = json.dumps({"dtype": arr.dtype.name,
                              "shape": list(arr.shape)}).encode()
         meta = _THDR.pack(len(header)) + header
+        nbytes = _HDR.size + len(meta) + arr.nbytes
+        t0 = time.perf_counter()
         try:
             if native.available():
                 # zero-copy: numpy buffer goes straight into the writev
                 native.send_tensor_frame(self._fd, ord("T"), meta, arr)
+                self.bytes_sent += nbytes
+                self._pace(nbytes, t0)
                 return
             self.sock.sendall(_HDR.pack(ord("T"), len(meta) + arr.nbytes))
             self.sock.sendall(meta)
             self.sock.sendall(memoryview(arr).cast("B"))
         except (BlockingIOError, InterruptedError) as e:
             raise TimeoutError("send timed out (socket timeout)") from e
+        self.bytes_sent += nbytes
+        self._pace(nbytes, t0)
 
     def recv_tensor(self, out: np.ndarray | None = None) -> np.ndarray:
         kind, length = self._recv_frame_header()

@@ -3,6 +3,8 @@ replacement for torch-dataset (reference call sites: examples/mnist.lua:26-40,
 examples/cifar10.lua:53-72, examples/Data.lua)."""
 
 from distlearn_tpu.data.dataset import (Dataset, make_dataset, load_npz,
+                                        synthetic_hard,
+                                        synthetic_hard_cifar10,
                                         synthetic_mnist, synthetic_cifar10,
                                         synthetic_imagenet)
 from distlearn_tpu.data.samplers import (PermutationSampler, LabelUniformSampler,
@@ -11,7 +13,9 @@ from distlearn_tpu.data.prefetch import prefetch_to_device, batch_iterator
 from distlearn_tpu.data.device_dataset import DeviceDataset
 
 __all__ = [
-    "Dataset", "make_dataset", "load_npz", "synthetic_mnist", "synthetic_cifar10", "synthetic_imagenet",
+    "Dataset", "make_dataset", "load_npz", "synthetic_mnist",
+    "synthetic_cifar10", "synthetic_imagenet", "synthetic_hard",
+    "synthetic_hard_cifar10",
     "PermutationSampler", "LabelUniformSampler", "make_sampler",
     "prefetch_to_device", "batch_iterator", "DeviceDataset",
 ]

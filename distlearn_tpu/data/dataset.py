@@ -78,6 +78,19 @@ def load_npz(path: str, x_key: str = "x", y_key: str = "y",
     return x, y, num_classes
 
 
+def _smooth_templates(trng, num: int, shape: tuple[int, ...]) -> np.ndarray:
+    """``num`` spatially-smooth unit-RMS templates (coarse noise upsampled
+    4x) — shared by the easy class-template set and the hard two-factor
+    set so "same smooth-template recipe" stays true by construction."""
+    h, w = shape[0], shape[1]
+    rest = shape[2:]
+    coarse = trng.randn(num, max(1, -(-h // 4)), max(1, -(-w // 4)),
+                        *rest).astype(np.float32)
+    t = np.repeat(np.repeat(coarse, 4, axis=1), 4, axis=2)[:, :h, :w]
+    return t / np.sqrt((t ** 2).mean(axis=tuple(range(1, t.ndim)),
+                                     keepdims=True))
+
+
 def _synthetic_classification(n: int, shape: tuple[int, ...], num_classes: int,
                               seed: int, signal: float = 8.0):
     """Class-conditional Gaussian images: each class has a fixed random
@@ -95,15 +108,7 @@ def _synthetic_classification(n: int, shape: tuple[int, ...], num_classes: int,
     # train and test draws (different seeds) must share the same class
     # structure or held-out accuracy is structurally stuck at chance.
     trng = np.random.RandomState(0x5EED ^ num_classes ^ (shape[0] << 8))
-    h, w = shape[0], shape[1]
-    rest = shape[2:]
-    coarse = trng.randn(num_classes, max(1, -(-h // 4)), max(1, -(-w // 4)),
-                        *rest).astype(np.float32)
-    templates = np.repeat(np.repeat(coarse, 4, axis=1), 4, axis=2)[
-        :, :h, :w]
-    # unit RMS per template, so `signal` keeps its meaning
-    templates /= np.sqrt((templates ** 2).mean(axis=tuple(
-        range(1, templates.ndim)), keepdims=True))
+    templates = _smooth_templates(trng, num_classes, shape)
     y = rng.randint(0, num_classes, size=n).astype(np.int32)
     x = templates[y] * (signal / np.sqrt(np.prod(shape))) \
         + rng.randn(n, *shape).astype(np.float32) * 0.5
@@ -120,6 +125,64 @@ def synthetic_mnist(n: int = 4096, seed: int = 0):
 def synthetic_cifar10(n: int = 4096, seed: int = 0):
     """CIFAR-shaped [n,32,32,3] synthetic set."""
     x, y = _synthetic_classification(n, (32, 32, 3), 10, seed)
+    return x, y, 10
+
+
+def synthetic_hard(n: int, shape: tuple[int, ...] = (32, 32, 3),
+                   num_classes: int = 10, seed: int = 0,
+                   signal: float = 8.0, label_noise: float = 0.05,
+                   return_latents: bool = False):
+    """A synthetic set that is NOT linearly separable by construction —
+    the honest companion to :func:`_synthetic_classification`, whose
+    class-conditional Gaussians a matched filter solves to ~1.0 accuracy
+    (so every accuracy row looks perfect regardless of training quality).
+
+    Each example composes TWO latent smooth templates: factor ``a`` and
+    factor ``b`` (``num_classes`` choices each), and the label is
+    ``(a + b) mod num_classes``.  Every class therefore mixes
+    ``num_classes`` modes whose MEAN is identical across classes (each
+    factor value appears in every class equally often), so any linear
+    model — matched filter, logistic regression on pixels — sits at
+    chance; decoding requires recovering both factors and combining them
+    nonlinearly, which a convnet does.  ``label_noise`` flips that
+    fraction of labels uniformly, making the best reachable accuracy
+    ``~(1 - label_noise * (C-1)/C)`` — a visible, meaningful ceiling
+    below 1.0.
+
+    Returns ``(x, y)`` (+ ``(a, b)`` latents with ``return_latents`` for
+    tests).  Same smooth-template recipe as the easy set, so convnets
+    remain the right architecture class.
+    """
+    rng = np.random.RandomState(seed)
+    C = num_classes
+    h, w = shape[0], shape[1]
+    rest = shape[2:]
+
+    def make_templates(tag):
+        return _smooth_templates(
+            np.random.RandomState(0xA5EED ^ tag ^ C ^ (h << 8)), C, shape)
+
+    ta, tb = make_templates(1), make_templates(2)
+    a = rng.randint(0, C, size=n).astype(np.int32)
+    b = rng.randint(0, C, size=n).astype(np.int32)
+    y = ((a + b) % C).astype(np.int32)
+    amp = signal / np.sqrt(np.prod(shape))
+    x = (ta[a] + tb[b]) * amp \
+        + rng.randn(n, *shape).astype(np.float32) * 0.5
+    if label_noise > 0:
+        flip = rng.rand(n) < label_noise
+        y = np.where(flip, rng.randint(0, C, size=n).astype(np.int32), y)
+    if return_latents:
+        return x.astype(np.float32), y, a, b
+    return x.astype(np.float32), y
+
+
+def synthetic_hard_cifar10(n: int = 4096, seed: int = 0,
+                           label_noise: float = 0.05):
+    """CIFAR-shaped non-separable synthetic set (see
+    :func:`synthetic_hard`)."""
+    x, y = synthetic_hard(n, (32, 32, 3), 10, seed,
+                          label_noise=label_noise)
     return x, y, 10
 
 

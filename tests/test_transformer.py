@@ -115,3 +115,103 @@ def test_remat_mode_validation():
     from distlearn_tpu.models.transformer import transformer_lm
     with pytest.raises(ValueError, match="remat"):
         transformer_lm(vocab=8, dim=8, depth=1, heads=1, remat="bogus")
+
+
+def test_scan_blocks_matches_unrolled():
+    """The scanned-depth layout is the same function: identical logits and
+    gradients once the parameters are stacked."""
+    from distlearn_tpu.models.transformer import (lm_loss,
+                                                  stack_block_params,
+                                                  transformer_lm,
+                                                  unstack_block_params)
+    depth = 3
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)),
+                       jnp.int32)
+    lm_u = transformer_lm(vocab=64, dim=32, depth=depth, heads=4, max_len=16)
+    lm_s = transformer_lm(vocab=64, dim=32, depth=depth, heads=4, max_len=16,
+                          scan_blocks=True)
+    params_u, _ = lm_u.init(jax.random.PRNGKey(0))
+    params_s = stack_block_params(params_u, depth)
+    # round trip
+    rt = unstack_block_params(params_s, depth)
+    for a, b in zip(jax.tree_util.tree_leaves(params_u),
+                    jax.tree_util.tree_leaves(rt)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    out_u = np.asarray(lm_u.apply(params_u, {}, toks)[0])
+    out_s = np.asarray(lm_s.apply(params_s, {}, toks)[0])
+    # same math, different op order (gathered stacked leaves): f32 noise
+    np.testing.assert_allclose(out_s, out_u, rtol=2e-5, atol=5e-6)
+
+    g_u = jax.grad(lambda p: lm_loss(lm_u, p, toks))(params_u)
+    g_s = jax.grad(lambda p: lm_loss(lm_s, p, toks))(params_s)
+    g_s_unstacked = unstack_block_params(g_s, depth)
+    for (pa, a), (pb, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(g_u)[0],
+                   key=lambda t: str(t[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(g_s_unstacked)[0],
+                   key=lambda t: str(t[0]))):
+        assert str(pa) == str(pb)
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=1e-6, err_msg=str(pa))
+
+
+def test_scan_blocks_program_size_flat_in_depth():
+    """The point of the scanned layout: the jitted program stops growing
+    ~linearly with depth (the unrolled loop's growth is what made deep
+    long-context configs exceed compile limits)."""
+    from distlearn_tpu.models.transformer import lm_loss, transformer_lm
+
+    def hlo_len(depth, scan):
+        lm = transformer_lm(vocab=64, dim=32, depth=depth, heads=4,
+                            max_len=16, scan_blocks=scan)
+        params, _ = lm.init(jax.random.PRNGKey(0))
+        toks = jnp.zeros((1, 16), jnp.int32)
+        f = jax.jit(jax.grad(lambda p: lm_loss(lm, p, toks)))
+        return len(f.lower(params).as_text())
+
+    grow_unrolled = hlo_len(8, False) / hlo_len(2, False)
+    grow_scanned = hlo_len(8, True) / hlo_len(2, True)
+    assert grow_unrolled > 2.5, grow_unrolled    # ~4x expected
+    assert grow_scanned < 1.4, grow_scanned      # ~flat
+
+
+def test_scan_blocks_with_lm_step_and_tp():
+    """The scanned layout composes with the fused train step: param_specs
+    shifts the TP axes one right for the stacked leaves."""
+    from distlearn_tpu.models.transformer import (lm_loss,
+                                                  stack_block_params,
+                                                  transformer_lm)
+    from distlearn_tpu.train.lm import build_lm_step
+
+    depth, L = 2, 32
+    lm_u = transformer_lm(vocab=32, dim=32, depth=depth, heads=4, max_len=L)
+    lm_s = transformer_lm(vocab=32, dim=32, depth=depth, heads=4, max_len=L,
+                          scan_blocks=True)
+    params_u, _ = lm_u.init(jax.random.PRNGKey(0))
+    params_s = stack_block_params(params_u, depth)
+    toks = np.random.RandomState(0).randint(0, 32, (4, L)).astype(np.int32)
+    _, ref_g = jax.value_and_grad(
+        lambda p: lm_loss(lm_u, p, jnp.asarray(toks)))(params_u)
+    from distlearn_tpu.models.transformer import stack_block_params as sbp
+    ref_g_s = sbp(ref_g, depth)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "seq", "model"))
+    step = build_lm_step(lm_s, mesh, params_s, lr=1.0, donate=False)
+    tk = jax.device_put(toks, NamedSharding(mesh, P("data", "seq")))
+    newp, _ = step(params_s, tk)
+    for a, b, g in zip(jax.tree_util.tree_leaves(params_s),
+                       jax.tree_util.tree_leaves(newp),
+                       jax.tree_util.tree_leaves(ref_g_s)):
+        implied = np.asarray(a) - np.asarray(b)
+        denom = max(1e-12, float(np.abs(np.asarray(g)).max()))
+        err = float(np.abs(implied - np.asarray(g)).max()) / denom
+        assert err < 3e-5, err
+
+
+def test_scan_blocks_rejects_moe():
+    from distlearn_tpu.models.transformer import transformer_lm
+    with pytest.raises(ValueError, match="scan_blocks"):
+        transformer_lm(vocab=8, dim=8, depth=2, heads=1, scan_blocks=True,
+                       moe_experts=2)
