@@ -28,9 +28,12 @@ def main():
         "dp": (2, "data-parallel mesh axis size"),
         "sp": (2, "sequence-parallel axis size (ring attention shards)"),
         "tp": (2, "tensor-parallel axis size (Megatron projections)"),
-        "pp": (0, "pipeline-parallel stages (GPipe, depth/pp blocks per "
-                  "stage; requires --sp 1 --tp 1 and --depth % --pp == 0)"),
-        "microbatches": (4, "GPipe microbatches per step (with --pp)"),
+        "pp": (0, "pipeline-parallel stages (depth/pp blocks per stage; "
+                  "requires --sp 1 --tp 1 and --depth % --pp == 0)"),
+        "ppSchedule": ("gpipe", "pipeline schedule: gpipe | 1f1b (1f1b "
+                       "starts each microbatch's backward as it leaves "
+                       "the last stage — O(stages) activation liveness)"),
+        "microbatches": (4, "pipeline microbatches per step (with --pp)"),
         "dim": (128, "model width"),
         "depth": (4, "number of blocks"),
         "vocab": (256, "vocabulary size"),
@@ -39,13 +42,26 @@ def main():
         "steps": (30, "training steps"),
         "learningRate": (0.1, "SGD learning rate"),
         "seqImpl": ("ring", "sequence attention: ring | alltoall"),
+        "seqLayout": ("contig", "sequence shard layout: contig | zigzag "
+                      "(zigzag balances the causal ring so masked blocks "
+                      "are never computed; needs --seqImpl ring)"),
+        "attnImpl": ("", "single-device attention kernel: '' (env default)"
+                     " | xla | flash | chunked (chunked = causal FLOP skip"
+                     " + saved softmax weights — the measured v5e winner)"),
+        "scanBlocks": (False, "scanned-depth layout: block params stacked,"
+                       " depth loop as one lax.scan (program size flat in"
+                       " depth; dense models only)"),
         "moeExperts": (0, "experts per MoE block (0 = dense; must equal "
                           "--dp, experts shard over the data axis)"),
         "moeTopK": (1, "experts per token (1 = Switch, 2 = GShard)"),
         "moeBalanceWeight": (0.01, "Switch load-balancing auxiliary loss "
                                    "weight (0 disables; without it top-1 "
                                    "routing collapses onto few experts)"),
-        "remat": (False, "jax.checkpoint each block (long-context memory)"),
+        "remat": (False, "jax.checkpoint each block (long-context memory;"
+                  " same as --rematMode full)"),
+        "rematMode": ("", "'' | full | mlp — mlp checkpoints only the FFN "
+                      "half, keeping attention residuals saved (selective "
+                      "activation recomputation)"),
         "zero": (False, "train with Adam under ZeRO-1: optimizer state + "
                         "f32 masters sharded over the data axis, composed "
                         "with the sp/tp axes (train.build_lm_zero_mesh_step;"
@@ -61,6 +77,21 @@ def main():
         "tpu": (False, "run on the TPU backend"),
         "seed": (0, "init seed"),
     })
+    remat = opt.rematMode or ("full" if opt.remat else False)
+    if opt.seqLayout not in ("contig", "zigzag"):
+        raise SystemExit(f"--seqLayout {opt.seqLayout!r}: contig | zigzag")
+    if opt.seqLayout == "zigzag":
+        if opt.seqImpl != "ring":
+            raise SystemExit("--seqLayout zigzag needs --seqImpl ring")
+        if opt.pp or opt.zero:
+            raise SystemExit("--seqLayout zigzag composes with the fused "
+                             "sgd/optax steps (not --pp/--zero)")
+    if opt.scanBlocks and (opt.moeExperts or opt.pp):
+        raise SystemExit("--scanBlocks needs a homogeneous dense stack "
+                         "and the non-pp step (pipeline stages shard the "
+                         "per-block layout)")
+    if opt.ppSchedule not in ("gpipe", "1f1b"):
+        raise SystemExit(f"--ppSchedule {opt.ppSchedule!r}: gpipe | 1f1b")
     if opt.pp:
         if opt.sp != 1 or opt.tp != 1:
             raise SystemExit("--pp composes with data parallelism only: "
@@ -76,6 +107,11 @@ def main():
                              "microbatching IS the accumulation lever on "
                              "this path; MoE/ZeRO/optax need the non-pp "
                              "step)")
+        if remat == "mlp":
+            raise SystemExit("--rematMode mlp is the non-pp step's "
+                             "selective mode; the pipeline stage fn "
+                             "checkpoints whole blocks — use --remat "
+                             "(full) with --pp")
     n_dev = opt.dp * opt.sp * opt.tp * max(1, opt.pp)
     setup_platform(n_dev, opt.tpu)
 
@@ -90,6 +126,7 @@ def main():
     from distlearn_tpu.models.transformer import (lm_loss, param_specs,
                                                   transformer_lm)
     from distlearn_tpu.train.lm import (build_lm_moe_metrics,
+                                        build_lm_pp_1f1b_step,
                                         build_lm_pp_step, build_lm_step,
                                         stack_blocks)
     from distlearn_tpu.utils.logging import root_print
@@ -108,7 +145,8 @@ def main():
         vocab=opt.vocab, dim=opt.dim, depth=opt.depth,
         heads=max(4, opt.dim // 64), max_len=opt.seqLen,
         compute_dtype=cdtype,
-        seq_impl=opt.seqImpl, remat=opt.remat,
+        seq_impl=opt.seqImpl, remat=remat,
+        attn_impl=opt.attnImpl or None, scan_blocks=opt.scanBlocks,
         moe_experts=opt.moeExperts, moe_top_k=opt.moeTopK)
     params, _ = lm.init(random.PRNGKey(opt.seed))
     if opt.pp:
@@ -119,10 +157,12 @@ def main():
         shared, stacked = stack_blocks(params, opt.depth)
         shared = jax.device_put(shared, NamedSharding(mesh, P()))
         stacked = jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
-        pp_step = build_lm_pp_step(mesh, shared, stacked,
-                                   lr=opt.learningRate,
-                                   num_microbatches=opt.microbatches,
-                                   compute_dtype=cdtype, remat=opt.remat)
+        builder = (build_lm_pp_1f1b_step if opt.ppSchedule == "1f1b"
+                   else build_lm_pp_step)
+        pp_step = builder(mesh, shared, stacked,
+                          lr=opt.learningRate,
+                          num_microbatches=opt.microbatches,
+                          compute_dtype=cdtype, remat=bool(remat))
         state = {"shared": shared, "stacked": stacked}
 
         def step(st, tokens):
@@ -136,6 +176,14 @@ def main():
         log(f"mesh dp={opt.dp} sp={opt.sp} tp={opt.tp} on "
             f"{devs[0].platform}; seq_impl={opt.seqImpl}"
             + (f"; {opt.moeExperts} experts" if opt.moeExperts else ""))
+        if opt.attnImpl and opt.sp > 1:
+            log(f"NOTE: --attnImpl {opt.attnImpl} is inert with --sp "
+                f"{opt.sp} > 1 — the ring/all-to-all blockwise path "
+                "takes over (see parallel/sequence.py ring_attention)")
+        elif opt.attnImpl == "chunked" and opt.seqLen // max(1, opt.sp)                 <= 1024:
+            log(f"NOTE: --attnImpl chunked falls back to xla at local "
+                f"length {opt.seqLen // max(1, opt.sp)} <= 1024 (the "
+                "chunk size); use a longer --seqLen to engage it")
         ep_axis = "data" if opt.moeExperts else None
         placed = jax.device_put(
             params, jax.tree_util.tree_map(
@@ -177,7 +225,8 @@ def main():
                                  f"(sgd | {' | '.join(makers)})")
             tx = makers[opt.optimizer](opt.learningRate)
             step = build_lm_optax_step(lm, mesh, tx,
-                                       accum_steps=opt.accumSteps)
+                                       accum_steps=opt.accumSteps,
+                                       seq_layout=opt.seqLayout)
             params = LMOptaxState(placed, tx.init(placed))
             log(f"{opt.optimizer} via the replicated-state optax LM step")
         else:
@@ -185,7 +234,8 @@ def main():
                 lm, mesh, params, lr=opt.learningRate,
                 ep_axis=ep_axis, accum_steps=opt.accumSteps,
                 moe_balance_weight=(opt.moeBalanceWeight
-                                    if opt.moeExperts else 0.0))
+                                    if opt.moeExperts else 0.0),
+                seq_layout=opt.seqLayout)
             params = placed
         tok_spec = P("data", "seq")
         if opt.moeExperts:
@@ -202,6 +252,11 @@ def main():
     for t in range(1, opt.seqLen):
         for b in range(opt.batchSize):
             toks[b, t] = rng.choice(opt.vocab, p=trans[toks[b, t - 1]])
+    if opt.seqLayout == "zigzag":
+        from distlearn_tpu.parallel.sequence import zigzag_indices
+        toks = toks[:, zigzag_indices(opt.sp, opt.seqLen)]
+        log("zigzag sequence layout: balanced causal ring (masked blocks "
+            "never computed)")
     tokens = jax.device_put(jnp.asarray(toks),
                             NamedSharding(mesh, tok_spec))
 
