@@ -365,8 +365,10 @@ def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(f"attention impl must be 'xla', 'flash', or "
                          f"'chunked', got {impl!r}")
     if impl == "chunked":
-        if causal and L > 1024 and L % 1024 == 0:
-            return chunked_causal_attention(q, k, v)
+        import os
+        chunk = int(os.environ.get("DISTLEARN_TPU_CHUNK", "1024"))
+        if causal and L > chunk and L % chunk == 0:
+            return chunked_causal_attention(q, k, v, chunk=chunk)
         impl = "xla"     # chunking only pays off via the causal FLOP skip
     if impl == "flash":
         # the Pallas kernel's default blocking needs L to be a multiple of

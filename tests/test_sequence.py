@@ -379,3 +379,27 @@ def test_zigzag_noncausal_is_plain_ring():
     ref = local_attention(q, k, v, causal=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_bf16_against_f32_oracle():
+    """bf16 zigzag ring vs the f32 full-attention oracle: the f32
+    softmax-stat accumulation must keep bf16 shards within bf16-level
+    error of the exact result (mirrors the contiguous-ring bf16 test)."""
+    from distlearn_tpu.parallel.sequence import ring_attention, zigzag_indices
+    rng = np.random.RandomState(12)
+    mk32 = lambda: jnp.asarray(rng.randn(1, 64, 2, 16).astype(np.float32))
+    q, k, v = mk32(), mk32(), mk32()
+    n = 4
+    mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+    idx = zigzag_indices(n, 64)
+    inv = np.argsort(idx)
+    out = jax.jit(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "seq", causal=True,
+                                       layout="zigzag"),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))(
+            q[:, idx].astype(jnp.bfloat16), k[:, idx].astype(jnp.bfloat16),
+            v[:, idx].astype(jnp.bfloat16))[:, inv]
+    ref = local_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
