@@ -413,7 +413,9 @@ def multichip_suite(ar_mb: int = 64):
     out: dict = {"devices": n_dev, "platform": platform}
 
     # -- allreduce busbw vs ICI spec ----------------------------------------
-    ar = allreduce_bench(ar_mb)
+    # (CPU proxy: fewer iterations — the 8-virtual-devices-on-one-core
+    # collective is minutes per window at full count)
+    ar = allreduce_bench(ar_mb, iters=20 if platform == "tpu" else 5)
     spec = _ici_link_spec() if platform == "tpu" else None
     if spec:
         ar["ici_link_spec_gb_s"] = spec
@@ -426,10 +428,11 @@ def multichip_suite(ar_mb: int = 64):
     # evidence, not throughput
     on_tpu = platform == "tpu"
     per_dev_batch = int(os.environ.get("BENCH_MC_BATCH",
-                                       "64" if on_tpu else "8"))
+                                       "64" if on_tpu else "4"))
     scan_k = max(1, int(os.environ.get("BENCH_MC_SCAN_K",
                                        "4" if on_tpu else "2")))
-    iters = int(os.environ.get("BENCH_MC_ITERS", "5" if on_tpu else "2"))
+    iters = int(os.environ.get("BENCH_MC_ITERS", "5" if on_tpu else "1"))
+    mc_windows = 3 if on_tpu else 2
 
     def cifar_sps(num_nodes):
         from distlearn_tpu.train import build_sgd_scan_step, init_train_state
@@ -443,8 +446,9 @@ def multichip_suite(ar_mb: int = 64):
         step = build_sgd_scan_step(model, tree, lr=0.1)
         bx, by = _stacked_cifar_batches(tree, per_dev_batch * num_nodes,
                                         scan_k)
-        sps, _, _ = bench_step_fn(step, ts, bx, by, iters * scan_k, 3,
-                                  scan_k, steps_per_call=scan_k)
+        sps, _, _ = bench_step_fn(step, ts, bx, by, iters * scan_k,
+                                  mc_windows, scan_k,
+                                  steps_per_call=scan_k)
         return sps
 
     sps_1 = cifar_sps(1)
@@ -465,8 +469,9 @@ def multichip_suite(ar_mb: int = 64):
     tau = int(os.environ.get("BENCH_EA_TAU", "10" if on_tpu else "2"))
     bx, by = _stacked_cifar_batches(tree, per_dev_batch * n_dev, tau)
     # one cyc() call = tau local steps + ONE elastic round
-    ea_sps, _, _ = bench_step_fn(cyc, ets, bx, by, 3 * tau, 3, tau,
-                                 steps_per_call=tau)
+    ea_sps, _, _ = bench_step_fn(cyc, ets, bx, by,
+                                 (3 if on_tpu else 1) * tau, mc_windows,
+                                 tau, steps_per_call=tau)
     out["easgd_round"] = {"tau": tau,
                           "cycles_per_sec": ea_sps / tau,
                           "local_steps_per_sec": ea_sps}
@@ -521,13 +526,17 @@ def multichip_suite(ar_mb: int = 64):
 def multichip_proxy_cpu(n: int = 8):
     """1-chip host: run :func:`multichip_suite` on an ``n``-device virtual
     CPU mesh in a subprocess (same command path real hardware will take),
-    labeling the result a proxy."""
+    labeling the result a proxy.  The proxy defaults to a smaller
+    allreduce payload than the real-mesh default — 8 virtual devices
+    time-share ONE core here, and a 64 MB collective pushed the run past
+    its timeout (observed) for no extra protocol coverage."""
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
+    env.setdefault("BENCH_AR_MB", "16")
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--multichip-probe"],
-            env=env, capture_output=True, timeout=1800, text=True)
+            env=env, capture_output=True, timeout=2700, text=True)
         rec = json.loads(out.stdout.strip().splitlines()[-1])
         rec["proxy"] = "cpu_virtual_mesh"
         return rec
@@ -1103,7 +1112,30 @@ def main():
             details["chip_health_tflops"] = probe
             print(f"[bench] chip health probe: {probe:.1f} TFLOP/s "
                   "(chained bf16 matmul; healthy ~100-143, degraded "
-                  "windows observed at ~6)", file=sys.stderr)
+                  "windows observed at ~1-6)", file=sys.stderr)
+        if probe is not None and probe < 15.0:
+            # The chip runs 10-100x under spec for hours at a time
+            # (observed).  A full-length run on a sick chip times out and
+            # records NOTHING; shrunk windows record honest (labeled)
+            # numbers plus the probe that explains them.  Only defaults
+            # shrink — explicit env settings are respected.
+            details["degraded_chip_mode"] = True
+            print("[bench] DEGRADED CHIP: shrinking default iteration "
+                  "counts so the run completes; rows reflect the sick "
+                  "chip, see chip_health_tflops", file=sys.stderr)
+            for var, small in (("BENCH_ITERS", "20"),
+                               ("BENCH_WINDOWS", "2"),
+                               ("BENCH_SCAN_K", "10"),
+                               ("BENCH_RESNET_ITERS", "4"),
+                               ("BENCH_LM_LONG_ITERS", "3"),
+                               ("BENCH_LM_LONG_CFGS", "1x4096"),
+                               ("BENCH_LM_ITERS", "5"),
+                               ("BENCH_EA_TAU", "5")):
+                os.environ.setdefault(var, small)
+            batch = int(os.environ.get("BENCH_BATCH", "256"))
+            iters = int(os.environ["BENCH_ITERS"])
+            windows = int(os.environ["BENCH_WINDOWS"])
+            warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
     # --- headline: CIFAR-10 convnet fused AllReduceSGD ---------------------
     # Measured on the SCANNED step (train.build_sgd_scan_step: K chained
@@ -1235,7 +1267,7 @@ def main():
                   f"{h['ring_busbw_gb_s']:.2f} GB/s "
                   f"({h['ring_speedup']:.2f}x shared-CPU; "
                   f"{h['ring_speedup_emulated']:.2f}x on emulated "
-                  f"{h['emulated_link_mb_s']:.0f} MB/s links; busiest link "
+                  f"{h['emulated_link_mb_s']:.0f} MB/s links; busiest NIC "
                   f"{h['ring_max_nic_bytes']/1e6:.1f} vs "
                   f"{h['tree_max_nic_bytes']/1e6:.1f} MB)",
                   file=sys.stderr)
