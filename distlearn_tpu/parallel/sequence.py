@@ -278,7 +278,7 @@ def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def chunked_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                             chunk: int = 1024) -> jax.Array:
+                             chunk: int | None = None) -> jax.Array:
     """Causal attention with the masked half of the score matrix never
     computed — a portable (pure-XLA) counterpart to flash attention tuned
     for the opposite end of the memory/compute trade.
@@ -297,8 +297,18 @@ def chunked_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     Only the diagonal sub-block gets a mask; the strict-past prefix is
     computed unmasked — no [L, L] predicate materialization.
+
+    ``chunk=None`` picks ``max(128, L // 32)``: the measured v5e sweep
+    at L=4096 improves monotonically down to 128 (5.6 -> 11.3 steps/s
+    on the full train step across 2048/1024/512/256/128), while capping
+    the chunk count at 32 keeps the unrolled per-block program bounded
+    for very long sequences (the compile-size failure mode the scanned
+    depth layout exists for).  Chunks must stay multiples of the
+    128-lane tile — 384 measured catastrophically (6.1 steps/s).
     """
     B, L, H, D = q.shape
+    if chunk is None:
+        chunk = max(128, L // 32)
     if L % chunk or L <= chunk:
         return local_attention(q, k, v, causal=True, impl="xla")
     scale = 1.0 / (D ** 0.5)
@@ -366,7 +376,8 @@ def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                          f"'chunked', got {impl!r}")
     if impl == "chunked":
         import os
-        chunk = int(os.environ.get("DISTLEARN_TPU_CHUNK", "1024"))
+        env_chunk = os.environ.get("DISTLEARN_TPU_CHUNK")
+        chunk = int(env_chunk) if env_chunk else max(128, L // 32)
         if causal and L > chunk and L % chunk == 0:
             return chunked_causal_attention(q, k, v, chunk=chunk)
         impl = "xla"     # chunking only pays off via the causal FLOP skip
