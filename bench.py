@@ -1087,9 +1087,19 @@ def chip_health_probe():
     x = jnp.ones((4096, 4096), jnp.bfloat16)
     f = jax.jit(lambda a: a @ a / 64.0)
     _ = np.asarray(jax.device_get(f(x)))
+    # short probe first: on a badly degraded chip the full 30-matmul
+    # chain has itself been observed to take minutes — extrapolate from
+    # 3 instead of risking the whole bench run on the canary
     t0 = _t.perf_counter()
-    N = 30
     r = x
+    for _ in range(3):
+        r = f(r)
+    _ = np.asarray(jax.device_get(r))
+    dt3 = _t.perf_counter() - t0
+    if dt3 > 3.0:
+        return 2 * 4096**3 * 3 / dt3 / 1e12
+    t0 = _t.perf_counter()
+    N = 27
     for _ in range(N):
         r = f(r)
     _ = np.asarray(jax.device_get(r))
