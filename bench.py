@@ -480,7 +480,8 @@ def multichip_suite(ar_mb: int = 64):
     if n_dev >= 2:
         import jax.numpy as jnp
         from distlearn_tpu.models.transformer import transformer_lm
-        from distlearn_tpu.train.lm import build_lm_pp_step, stack_blocks
+        from distlearn_tpu.train.lm import (build_lm_pp_1f1b_step,
+                                            build_lm_pp_step, stack_blocks)
         S = min(4, n_dev)
         M = int(os.environ.get("BENCH_MC_PP_MICROBATCHES",
                                "8" if on_tpu else "4"))
@@ -499,8 +500,11 @@ def multichip_suite(ar_mb: int = 64):
         shared, stacked = stack_blocks(params, depth)
         shared = jax.device_put(shared, NamedSharding(pp_mesh, P()))
         stacked = jax.device_put(stacked, NamedSharding(pp_mesh, P("pipe")))
+        # donate=False: both schedules start from the SAME placed arrays
+        # (a donating step would consume them on its first call)
         step = build_lm_pp_step(pp_mesh, shared, stacked, lr=0.1,
-                                num_microbatches=M, remat=True)
+                                num_microbatches=M, remat=True,
+                                donate=False)
         toks = jax.device_put(
             np.random.RandomState(0).randint(0, 2048, (M * 2, seq))
             .astype(np.int32), NamedSharding(pp_mesh, P("data")))
@@ -520,6 +524,34 @@ def multichip_suite(ar_mb: int = 64):
             "tokens_per_sec": 3 * M * 2 * seq / med,
             "bubble_fraction": (S - 1) / (M + S - 1),
         }
+
+        # same pipeline under the 1F1B schedule: O(S) activation liveness
+        # vs GPipe's O(M) — throughput comparison + the compiled temp
+        # memory delta where the platform exposes it
+        step_f = build_lm_pp_1f1b_step(pp_mesh, shared, stacked, lr=0.1,
+                                       num_microbatches=M, remat=True,
+                                       donate=False)
+        st_f = {"s": shared, "k": stacked}
+
+        def run_pp_f(k):
+            sh, stk = st_f["s"], st_f["k"]
+            for _ in range(k):
+                sh, stk, loss = step_f(sh, stk, toks)
+            st_f["s"], st_f["k"] = sh, stk
+            float(jax.device_get(loss))
+
+        med_f, _ = timed_windows(lambda: run_pp_f(3), lambda: run_pp_f(1), 3)
+        row = {"stages": S, "microbatches": M,
+               "steps_per_sec": 3 / med_f,
+               "tokens_per_sec": 3 * M * 2 * seq / med_f,
+               "vs_gpipe": med / med_f}
+        try:
+            tb = lambda fn: fn.lower(shared, stacked, toks).compile()                 .memory_analysis().temp_size_in_bytes
+            row["temp_bytes"] = tb(step_f)
+            row["gpipe_temp_bytes"] = tb(step)
+        except Exception:   # noqa: BLE001 — not all platforms expose it
+            pass
+        out["pp_lm_1f1b"] = row
     return out
 
 
