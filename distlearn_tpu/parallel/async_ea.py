@@ -453,10 +453,18 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 idx, msg = self.broadcast.recv_any(timeout=0.5)
             except TimeoutError:
                 continue
-            except (ConnectionError, OSError, RuntimeError):
-                # RuntimeError: every broadcast conn closed (all clients
-                # finished/evicted) — dispatch is done
+            except RuntimeError:
+                # every broadcast conn closed (all clients finished or
+                # evicted) — dispatch is done
                 return
+            except (ConnectionError, OSError, ValueError):
+                # a worker EVICTING its client closes that client's
+                # broadcast conn while this thread is blocked in select on
+                # it — EBADF/negative-fd surfaces here.  That is one dead
+                # conn, not the end of dispatch: keep serving the others
+                # (exiting here orphaned the live clients' Enter? requests
+                # — observed as a full-suite wedge)
+                continue
             cid = self._admit(idx, msg)
             if cid is None:
                 continue

@@ -440,7 +440,12 @@ def test_concurrent_server_evicts_dead_client_others_continue():
     while srv.syncs_completed < 3:
         # generous: observed flaking at 30s when the full suite saturates
         # the 1-core host; solo it completes in well under a second
-        assert time.time() - t0 < 90.0, srv.syncs_completed
+        assert time.time() - t0 < 90.0, (
+            f"syncs={srv.syncs_completed} inflight={srv._inflight} "
+            f"evicted={srv.evicted} "
+            f"dispatch_closed={srv._dispatch_closed.is_set()} "
+            f"queues={[q.qsize() for q in srv._queues]} "
+            f"threads={[th.is_alive() for th in srv._threads]}")
         time.sleep(0.02)
     t1.join(timeout=20.0)
     t2.join(timeout=20.0)
