@@ -88,6 +88,11 @@ _TIMEOUT_ERRNOS = {_errno.EAGAIN, _errno.EWOULDBLOCK, _errno.ETIMEDOUT}
 def _check_rc(rc: int, what: str) -> None:
     if rc == -1:
         raise ConnectionError("peer closed connection")
+    if rc == -2:
+        # FIN landed after partial progress: a torn frame, not a finished
+        # peer — surfaced as the reset subclass so drop-policy code
+        # (transport.Server.recv_any) treats it as abnormal
+        raise ConnectionResetError("peer closed connection mid-frame")
     if rc != 0:
         if -rc in _TIMEOUT_ERRNOS:
             # SO_RCVTIMEO/SO_SNDTIMEO expired mid-operation (the per-handshake

@@ -202,7 +202,17 @@ class Tree:
             r += int(hdr["r"])
             for a in acc:
                 part = kid.recv_tensor()
-                native.reduce_inplace(a, part.astype(a.dtype, copy=False), op)
+                if part.dtype != a.dtype:
+                    # One framework, one policy: the AsyncEA server evicts
+                    # on dtype skew (parallel/async_ea.py _check_delta);
+                    # silently astype-ing a child's f64/int payload into
+                    # the accumulator here would hide the same config skew.
+                    raise ValueError(
+                        f"all_reduce dtype skew: child contributed "
+                        f"{part.dtype} against local {a.dtype} — "
+                        "rank model/config mismatch (all ranks must "
+                        "reduce identical dtypes)")
+                native.reduce_inplace(a, part, op)
         # Send to parent; receive final result down.
         if self._parent is not None:
             self._parent.send_msg({"n": n, "r": r})

@@ -219,17 +219,29 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: PyTree,
         buf = lax.dynamic_update_index_in_dim(buf, a_in, t % BUF, 0)
 
         # last stage: fold the loss share and seed the cotangent for this
-        # SAME microbatch's backward, which runs this very tick
+        # SAME microbatch's backward, which runs this very tick.  The head
+        # vjp (vocab-sized logits matmul + log-softmax + backward) is S
+        # times the necessary compute if every stage runs it only to mask
+        # the result — consume_fn contains no collectives, so lax.cond
+        # genuinely skips it on all ranks but the live last stage.
         def cons(cp, o):
             return consume_fn(cp, o, jnp.clip(m_f, 0, M - 1))
 
-        val, cvjp = jax.vjp(cons, consume_params, out)
-        g_cp_t, seed = cvjp(jnp.ones((), val.dtype))
         last_live = (idx == S - 1) & fwd_valid
-        share = share + jnp.where(last_live, val.astype(jnp.float32), zf32)
-        g_cons = jax.tree_util.tree_map(
-            lambda a, g: a + jnp.where(last_live, g, jnp.zeros_like(g)),
-            g_cons, g_cp_t)
+
+        def head_live(cp, o):
+            val, cvjp = jax.vjp(cons, cp, o)
+            g_cp_t, seed = cvjp(jnp.ones((), val.dtype))
+            return val.astype(jnp.float32), g_cp_t, seed.astype(act_dtype)
+
+        def head_skip(cp, o):
+            return (zf32, jax.tree_util.tree_map(jnp.zeros_like, cp),
+                    jnp.zeros(o.shape, act_dtype))
+
+        val, g_cp_t, seed = lax.cond(last_live, head_live, head_skip,
+                                     consume_params, out)
+        share = share + val
+        g_cons = jax.tree_util.tree_map(lambda a, g: a + g, g_cons, g_cp_t)
 
         # ---- backward half: 1F1B interleave -------------------------------
         m_b = t - (2 * S - 2) + idx        # this stage's bwd microbatch

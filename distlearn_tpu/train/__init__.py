@@ -7,19 +7,24 @@ from distlearn_tpu.train.trainer import (TrainState, EATrainState,
                                          build_sync_step,
                                          build_eval_step, build_ea_steps,
                                          build_ea_cycle, reduce_confusion)
-from distlearn_tpu.train.lm import (LMEAState, build_lm_ea_steps,
+from distlearn_tpu.train.lm import (LMEAState, LMMixedState,
+                                    build_lm_ea_steps,
+                                    build_lm_mixed_step,
                                     build_lm_moe_metrics,
                                     build_lm_pp_1f1b_step,
                                     build_lm_pp_step, build_lm_step,
-                                    init_lm_ea_state, stack_blocks,
-                                    unstack_blocks)
-from distlearn_tpu.train.optim import (LMOptaxState, LMZeroState,
+                                    init_lm_ea_state, init_lm_mixed_state,
+                                    stack_blocks, unstack_blocks)
+from distlearn_tpu.train.optim import (LMMixedOptaxState, LMOptaxState,
+                                       LMZeroState,
                                        OptaxTrainState, ZeroTrainState,
+                                       build_lm_mixed_optax_step,
                                        build_lm_optax_step,
                                        build_lm_zero_mesh_step,
                                        build_lm_zero_step,
                                        build_optax_step,
                                        build_zero_optax_step,
+                                       init_lm_mixed_optax_state,
                                        init_lm_zero_mesh_state,
                                        init_lm_zero_state, init_optax_state,
                                        init_zero_state)
@@ -37,4 +42,7 @@ __all__ = [
     "LMZeroState", "build_lm_zero_step", "init_lm_zero_state",
     "build_lm_zero_mesh_step", "init_lm_zero_mesh_state",
     "LMOptaxState", "build_lm_optax_step",
+    "LMMixedState", "build_lm_mixed_step", "init_lm_mixed_state",
+    "LMMixedOptaxState", "build_lm_mixed_optax_step",
+    "init_lm_mixed_optax_state",
 ]

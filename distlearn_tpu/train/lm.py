@@ -437,6 +437,91 @@ def build_lm_pp_1f1b_step(mesh: Mesh, shared_template, stacked_template,
     return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 
+class LMMixedState(NamedTuple):
+    """Mixed-precision LM train state: ``params`` is the bf16 WORKING copy
+    every matmul reads (2 bytes/param — halves the weight-read traffic of
+    the f32-param step across forward, dgrad, and wgrad), ``master`` the
+    f32 copy the update applies to (bf16's 8-bit mantissa underflows
+    ``p - lr*g`` when ``lr*g`` is ~256x smaller than ``p``; the master
+    keeps SGD exact).  Invariant: ``params == master.astype(bf16)``."""
+    params: Any
+    master: Any
+
+
+def init_lm_mixed_state(params, param_dtype=jnp.bfloat16) -> LMMixedState:
+    """Master := the f32 init; working copy := its ``param_dtype`` cast."""
+    cast = jax.tree_util.tree_map(
+        lambda p: p.astype(param_dtype), params)
+    return LMMixedState(params=cast, master=params)
+
+
+def build_lm_mixed_step(model: Model, mesh: Mesh, params_template, lr: float,
+                        data_axis: str = "data",
+                        seq_axis: str | None = "seq",
+                        tp_axis: str | None = "model",
+                        ep_axis: str | None = None, accum_steps: int = 1,
+                        moe_balance_weight: float = 0.0,
+                        grad_dtype=jnp.float32,
+                        donate: bool = True,
+                        seq_layout: str = "contig") -> Callable:
+    """:func:`build_lm_step` with bf16 working params + f32 masters:
+    ``step(st, tokens) -> (st, loss)`` on :class:`LMMixedState`.
+
+    Motivation (measured, docs/PERF.md): the f32-param step spends ~21%
+    of the dim-4096 step in the f32 ``p - lr*g`` elementwise update and
+    reads 4-byte weights in every matmul even though the MXU computes in
+    bf16 (the convert fuses into the matmul but the HBM read does not
+    shrink).  Storing the working copy in bf16 halves the weight bytes
+    the three matmul passes pull per step; the f32 master confines f32
+    elementwise traffic to the update itself.  Same mesh/sharding
+    contract as :func:`build_lm_step` (``params_template`` may be either
+    precision — only shapes matter for the specs).
+
+    ``grad_dtype`` is the dtype gradients are REDUCED and applied in
+    (default f32: bf16 grads from the bf16-param backward are upcast
+    before the data/seq psum, so the cross-replica sum accumulates full
+    precision; pass ``jnp.bfloat16`` to halve gradient ICI bytes when
+    the replica count is small enough for bf16 accumulation).
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
+    ep_grad_axes = tuple(a for a in axes if a != ep_axis)
+    pspecs = param_specs(params_template, tp_axis, ep_axis)
+    is_ep_leaf = jax.tree_util.tree_map(
+        lambda s: ep_axis is not None and ep_axis in s, pspecs)
+
+    def step(st: LMMixedState, tokens):
+        local_loss, grads = lm_local_grads(
+            model, st.params, tokens, seq_axis=seq_axis, tp_axis=tp_axis,
+            ep_axis=ep_axis, accum_steps=accum_steps,
+            moe_balance_weight=moe_balance_weight, seq_layout=seq_layout)
+        loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
+        dp = lax.psum(1, data_axis)
+
+        def reduce_grad(g, is_ep):
+            g = g.astype(grad_dtype)
+            gaxes = ep_grad_axes if is_ep else axes
+            if gaxes:
+                g = lax.psum(g, gaxes)
+            return g / jnp.asarray(dp, g.dtype)
+
+        grads = jax.tree_util.tree_map(reduce_grad, grads, is_ep_leaf)
+        master = jax.tree_util.tree_map(
+            lambda m, g: m - jnp.asarray(lr, m.dtype) * g.astype(m.dtype),
+            st.master, grads)
+        params = jax.tree_util.tree_map(
+            lambda p, m: m.astype(p.dtype), st.params, master)
+        return (LMMixedState(params, master),
+                lax.pmean(loss, data_axis))
+
+    tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    spec = LMMixedState(params=pspecs, master=pspecs)
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
+                           out_specs=(spec, P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
 class LMEAState(NamedTuple):
     """Per-node elastic-averaging state for LM training: every leaf has a
     leading ``[num_nodes]`` axis sharded over the data mesh axis (replicas

@@ -371,6 +371,73 @@ def build_lm_optax_step(model: Model, mesh, tx,
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+class LMMixedOptaxState(NamedTuple):
+    """Mixed-precision optax LM training: bf16 working ``params`` (what
+    the matmuls read), f32 ``master`` (what the optimizer walks), and the
+    optimizer state over the master (see
+    :class:`distlearn_tpu.train.lm.LMMixedState` for the traffic
+    analysis)."""
+    params: PyTree
+    master: PyTree
+    opt_state: PyTree
+
+
+def init_lm_mixed_optax_state(params, tx,
+                              param_dtype=jnp.bfloat16
+                              ) -> LMMixedOptaxState:
+    """Master := the f32 init, working copy := its cast, optimizer state
+    over the MASTER (moments accumulate in f32)."""
+    cast = jax.tree_util.tree_map(lambda p: p.astype(param_dtype), params)
+    return LMMixedOptaxState(params=cast, master=params,
+                             opt_state=tx.init(params))
+
+
+def build_lm_mixed_optax_step(model: Model, mesh, tx,
+                              data_axis: str = "data",
+                              seq_axis: str | None = "seq",
+                              accum_steps: int = 1,
+                              moe_balance_weight: float = 0.0,
+                              grad_dtype=jnp.float32,
+                              donate: bool = True,
+                              seq_layout: str = "contig") -> Callable:
+    """:func:`build_lm_optax_step` with bf16 working params + f32 masters
+    (``step(st, tokens) -> (st, loss)`` on :class:`LMMixedOptaxState`):
+    gradients come off the bf16-param backward, are upcast to
+    ``grad_dtype`` for the cross-replica psum, feed ``tx.update`` against
+    the f32 master, and the new master re-casts into the working copy —
+    the f32 elementwise traffic is confined to the optimizer itself while
+    every matmul pass reads 2-byte weights.  Initialize with
+    :func:`init_lm_mixed_optax_state`."""
+    from distlearn_tpu.train.lm import lm_local_grads
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    axes = tuple(a for a in (data_axis, seq_axis) if a is not None)
+
+    def step(st: LMMixedOptaxState, tokens):
+        local_loss, grads = lm_local_grads(
+            model, st.params, tokens, seq_axis=seq_axis, tp_axis=None,
+            accum_steps=accum_steps,
+            moe_balance_weight=moe_balance_weight, seq_layout=seq_layout)
+        loss = lax.psum(local_loss, seq_axis) if seq_axis else local_loss
+        dp = lax.psum(1, data_axis)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g.astype(grad_dtype), axes)
+            / jnp.asarray(dp, grad_dtype), grads)
+        updates, opt_state = tx.update(grads, st.opt_state, st.master)
+        master = jax.tree_util.tree_map(
+            lambda m, u: m + u.astype(m.dtype), st.master, updates)
+        params = jax.tree_util.tree_map(
+            lambda p, m: m.astype(p.dtype), st.params, master)
+        return (LMMixedOptaxState(params, master, opt_state),
+                lax.pmean(loss, data_axis))
+
+    tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
+    spec = LMMixedOptaxState(params=P(), master=P(), opt_state=P())
+    mapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
+                           out_specs=(spec, P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
 def _local_template(params: PyTree, pspecs: PyTree, mesh) -> PyTree:
     """ShapeDtypeStructs of each leaf's LOCAL shard under ``pspecs``."""
     def shrink(leaf, spec):

@@ -180,10 +180,15 @@ def main():
             log(f"NOTE: --attnImpl {opt.attnImpl} is inert with --sp "
                 f"{opt.sp} > 1 — the ring/all-to-all blockwise path "
                 "takes over (see parallel/sequence.py ring_attention)")
-        elif opt.attnImpl == "chunked" and opt.seqLen // max(1, opt.sp)                 <= 1024:
-            log(f"NOTE: --attnImpl chunked falls back to xla at local "
-                f"length {opt.seqLen // max(1, opt.sp)} <= 1024 (the "
-                "chunk size); use a longer --seqLen to engage it")
+        elif opt.attnImpl == "chunked":
+            from distlearn_tpu.parallel.sequence import (chunked_engages,
+                                                         resolve_chunk)
+            _L = opt.seqLen // max(1, opt.sp)
+            if not chunked_engages(_L):
+                log(f"NOTE: --attnImpl chunked falls back to xla at "
+                    f"local length {_L} with chunk {resolve_chunk(_L)} "
+                    "(needs L > chunk and L % chunk == 0); use a longer "
+                    "--seqLen or set DISTLEARN_TPU_CHUNK")
         ep_axis = "data" if opt.moeExperts else None
         placed = jax.device_put(
             params, jax.tree_util.tree_map(

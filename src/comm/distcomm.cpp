@@ -10,7 +10,10 @@
 // Wire protocol (must match distlearn_tpu/comm/transport.py):
 //   frame := kind:u8 | length:u64le | payload[length]
 //
-// All functions return 0 on success, -1 on peer-closed, or -errno.
+// All functions return 0 on success, -1 on clean peer-close (FIN before
+// any byte of the requested read), -2 on mid-read peer-close (FIN after
+// partial progress — a frame was torn, distinct from a finished peer), or
+// -errno.
 
 #include <cerrno>
 #include <cstdint>
@@ -74,7 +77,7 @@ int dc_recv_exact(int fd, uint8_t *buf, uint64_t len) {
   uint64_t got = 0;
   while (got < len) {
     ssize_t n = ::recv(fd, buf + got, len - got, 0);
-    if (n == 0) return -1; // peer closed
+    if (n == 0) return got ? -2 : -1; // peer closed (mid-read vs clean)
     if (n < 0) {
       if (errno == EINTR) continue;
       return -errno;

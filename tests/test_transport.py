@@ -148,3 +148,91 @@ def test_throttle_paces_sends():
     assert dt >= 0.08, dt
     assert got["r"].nbytes == arr.nbytes
     tx.close(); rx.close()
+
+
+def test_mid_frame_fin_raises_reset_not_clean_eof():
+    """A peer that dies after sending PART of a frame is a torn stream,
+    not a finished peer: the read must raise ConnectionResetError (the
+    abnormal-drop class recv_any's on_drop reports) while a FIN between
+    frames stays the plain 'peer closed connection' ConnectionError —
+    the discriminator the AsyncEA eviction/rejoin policy keys on."""
+    import struct as _struct
+
+    # FIN after 5 of 9 header bytes -> reset
+    tx, rx = _pair()
+    tx.sock.sendall(_struct.pack("<BQ", ord("J"), 64)[:5])
+    tx.close()
+    try:
+        rx.recv_msg()
+        raise AssertionError("expected ConnectionResetError")
+    except ConnectionResetError:
+        pass
+    rx.close()
+
+    # FIN after a complete header but before the payload -> reset
+    tx, rx = _pair()
+    tx.sock.sendall(_struct.pack("<BQ", ord("J"), 64))
+    tx.close()
+    try:
+        rx.recv_msg()
+        raise AssertionError("expected ConnectionResetError")
+    except ConnectionResetError:
+        pass
+    rx.close()
+
+    # FIN on a fresh frame boundary -> clean EOF (plain ConnectionError)
+    tx, rx = _pair()
+    tx.send_msg({"q": "bye"})
+    tx.close()
+    assert rx.recv_msg() == {"q": "bye"}
+    try:
+        rx.recv_msg()
+        raise AssertionError("expected ConnectionError")
+    except ConnectionResetError:
+        raise AssertionError("clean EOF misread as reset")
+    except ConnectionError:
+        pass
+    rx.close()
+
+
+def test_trickling_peer_cut_by_frame_deadline():
+    """frame_timeout must bound the WHOLE frame read: a peer trickling one
+    byte per just-under-timeout interval re-arms a kernel SO_RCVTIMEO on
+    every byte and would wedge forever — the monotonic deadline cuts it."""
+    import struct as _struct
+
+    from distlearn_tpu.comm.transport import Server, connect
+
+    srv = Server("127.0.0.1", 0)
+    peer = connect("127.0.0.1", srv.port)
+    srv.accept(1)
+
+    stop = threading.Event()
+
+    def trickle():
+        hdr = _struct.pack("<BQ", ord("J"), 64)
+        for b in hdr:
+            if stop.is_set():
+                return
+            try:
+                peer.sock.sendall(bytes([b]))
+            except OSError:
+                return
+            time.sleep(0.3)
+
+    t = threading.Thread(target=trickle, daemon=True)
+    t.start()
+    dropped = {}
+    t0 = time.perf_counter()
+    try:
+        srv.recv_any(timeout=10.0, frame_timeout=0.5,
+                     on_drop=lambda i, e: dropped.update(i=i, e=e))
+        raise AssertionError("expected the trickler to be dropped")
+    except TimeoutError:
+        pass
+    dt = time.perf_counter() - t0
+    assert dt < 5.0, f"deadline did not bound the trickle ({dt:.1f}s)"
+    assert "e" in dropped and isinstance(dropped["e"], TimeoutError)
+    stop.set()
+    peer.close()
+    srv.close()

@@ -135,6 +135,30 @@ def test_tree_ea_reference_invariant():
     assert gap < 1e-6, gap
 
 
+def test_allreduce_rejects_dtype_skew():
+    """One framework, one policy: a child contributing f64 against the
+    tree's f32 accumulator is a rank config mismatch and must be REJECTED
+    (matching the AsyncEA server's _check_delta eviction policy), not
+    silently astype'd into the sum (VERDICT r4 weak #5)."""
+    n, port = 2, _port()
+
+    def node(rank):
+        t = LocalhostTree(rank, n, port)
+        dt = np.float64 if rank == 1 else np.float32
+        try:
+            t.all_reduce({"v": np.ones((3,), dt)})
+            return "no-error"
+        except (ValueError, ConnectionError, TimeoutError) as e:
+            return type(e).__name__
+        finally:
+            t.close()
+
+    results = tree_map_spawn(node, n, timeout=30)
+    # the accumulating rank must raise ValueError; its peer may see the
+    # connection drop as the raising rank tears down
+    assert "ValueError" in results, results
+
+
 def test_barrier_and_ranks():
     n, port = 4, _port()
 
