@@ -66,6 +66,11 @@ def main():
                         "f32 masters sharded over the data axis, composed "
                         "with the sp/tp axes (train.build_lm_zero_mesh_step;"
                         " dense models only)"),
+        "mixed": (False, "bf16 working params + replicated f32 masters: "
+                         "every matmul pass reads 2-byte weights, the "
+                         "update stays exact (train.build_lm_mixed_step / "
+                         "build_lm_mixed_optax_step; not with --pp/--zero,"
+                         " which manage their own param layouts)"),
         "optimizer": ("sgd", "sgd | adam | adamw — non-sgd runs the "
                              "replicated-state optax step "
                              "(train.build_lm_optax_step; needs --tp 1)"),
@@ -92,6 +97,10 @@ def main():
                          "per-block layout)")
     if opt.ppSchedule not in ("gpipe", "1f1b"):
         raise SystemExit(f"--ppSchedule {opt.ppSchedule!r}: gpipe | 1f1b")
+    if opt.mixed and (opt.pp or opt.zero):
+        raise SystemExit("--mixed composes with the fused sgd/optax steps "
+                         "(--pp stages and --zero shards manage their own "
+                         "parameter layouts)")
     if opt.pp:
         if opt.sp != 1 or opt.tp != 1:
             raise SystemExit("--pp composes with data parallelism only: "
@@ -229,11 +238,34 @@ def main():
                 raise SystemExit(f"unknown --optimizer {opt.optimizer!r} "
                                  f"(sgd | {' | '.join(makers)})")
             tx = makers[opt.optimizer](opt.learningRate)
-            step = build_lm_optax_step(lm, mesh, tx,
-                                       accum_steps=opt.accumSteps,
-                                       seq_layout=opt.seqLayout)
-            params = LMOptaxState(placed, tx.init(placed))
-            log(f"{opt.optimizer} via the replicated-state optax LM step")
+            if opt.mixed:
+                from distlearn_tpu.train import (
+                    build_lm_mixed_optax_step, init_lm_mixed_optax_state)
+                step = build_lm_mixed_optax_step(
+                    lm, mesh, tx, accum_steps=opt.accumSteps,
+                    seq_layout=opt.seqLayout)
+                params = init_lm_mixed_optax_state(placed, tx)
+                log(f"{opt.optimizer}, mixed precision: bf16 working "
+                    "params + f32 masters")
+            else:
+                step = build_lm_optax_step(lm, mesh, tx,
+                                           accum_steps=opt.accumSteps,
+                                           seq_layout=opt.seqLayout)
+                params = LMOptaxState(placed, tx.init(placed))
+                log(f"{opt.optimizer} via the replicated-state optax "
+                    "LM step")
+        elif opt.mixed:
+            from distlearn_tpu.train import (build_lm_mixed_step,
+                                             init_lm_mixed_state)
+            step = build_lm_mixed_step(
+                lm, mesh, params, lr=opt.learningRate,
+                ep_axis=ep_axis, accum_steps=opt.accumSteps,
+                moe_balance_weight=(opt.moeBalanceWeight
+                                    if opt.moeExperts else 0.0),
+                seq_layout=opt.seqLayout)
+            params = init_lm_mixed_state(placed)
+            log("mixed precision: bf16 working params + f32 masters "
+                "(matmuls read 2-byte weights; the update stays exact)")
         else:
             step = build_lm_step(
                 lm, mesh, params, lr=opt.learningRate,
@@ -244,7 +276,9 @@ def main():
             params = placed
         tok_spec = P("data", "seq")
         if opt.moeExperts:
-            moe_metrics = build_lm_moe_metrics(lm, mesh, params,
+            # template = the raw placed params (the train state may wrap
+            # them, e.g. LMMixedState)
+            moe_metrics = build_lm_moe_metrics(lm, mesh, placed,
                                                ep_axis=ep_axis)
 
     # Synthetic corpus: order-2 Markov tokens — learnable next-token
@@ -288,7 +322,8 @@ def main():
             if i % 10 == 0 or i == opt.steps:
                 extra = ""
                 if opt.moeExperts and not opt.pp:
-                    m = jax.device_get(moe_metrics(params, tokens))
+                    m = jax.device_get(moe_metrics(
+                        getattr(params, "params", params), tokens))
                     extra = (f" [router balance "
                              f"{float(m['moe_balance_loss']):.3f}, dropped "
                              f"{float(m['moe_dropped_frac']):.3f}]")
