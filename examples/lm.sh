@@ -4,8 +4,9 @@
 # over the data axis), a dp2 x pipe4 GPipe pipeline (2 blocks per stage,
 # remat), the same pipeline under the 1F1B schedule (O(stages) activation
 # liveness), ZeRO-1 Adam with sharded f32 masters composed with sp/tp,
-# and the zigzag causal ring layout (masked attention blocks never
-# computed) with selective remat.
+# the zigzag causal ring layout (masked attention blocks never
+# computed) with selective remat, and mixed precision (bf16 working
+# params + f32 masters) on the full 3D mesh.
 cd "$(dirname "$0")"
 python lm.py --dp 2 --sp 2 --tp 2 "$@"
 python lm.py --dp 4 --sp 2 --tp 1 --moeExperts 4 --moeBalanceWeight 0.01 "$@"
@@ -13,3 +14,4 @@ python lm.py --dp 2 --sp 1 --tp 1 --pp 4 --depth 8 --remat "$@"
 python lm.py --dp 2 --sp 1 --tp 1 --pp 4 --depth 8 --ppSchedule 1f1b "$@"
 python lm.py --dp 2 --sp 2 --tp 2 --zero --learningRate 0.003 "$@"
 python lm.py --dp 2 --sp 4 --tp 1 --seqLayout zigzag --rematMode mlp "$@"
+python lm.py --dp 2 --sp 2 --tp 2 --mixed "$@"
