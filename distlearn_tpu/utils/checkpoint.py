@@ -43,6 +43,30 @@ def _path_elem(p) -> str:
     return str(p)
 
 
+def _vdtype_names(flat: dict[str, np.ndarray]) -> dict[str, str]:
+    """npz round-trips only NATIVE numpy dtypes: an ml_dtypes leaf
+    (bfloat16, float8_*) loads back as raw void (``|V2``).  Record the
+    true dtype name per affected key so restore can view the bytes back
+    — without this, bf16 train states (mixed-precision working params)
+    fail restore with a ``|V2 != bfloat16`` mismatch.  Structured
+    (record) dtypes are also kind 'V' but round-trip npz natively —
+    only field-less extension dtypes are recorded."""
+    return {k: a.dtype.name for k, a in flat.items()
+            if a.dtype.kind == "V" and a.dtype.fields is None}
+
+
+def _review_vdtype(arr: np.ndarray, want: np.dtype) -> np.ndarray:
+    """Bytes-preserving view of a void-loaded array back to its true
+    extension dtype (same itemsize — a pure reinterpretation).
+    Structured arrays pass through untouched."""
+    want = np.dtype(want)
+    if arr.dtype == want or arr.dtype.kind != "V" \
+            or arr.dtype.fields is not None \
+            or arr.dtype.itemsize != want.itemsize:
+        return arr
+    return arr.view(want)
+
+
 def _atomic_savez(directory: str, path: str, meta: dict,
                   flat: dict[str, np.ndarray]) -> None:
     """tmp-write + rename so a preempted job never sees a torn file."""
@@ -62,7 +86,10 @@ def save_checkpoint(directory: str, step: int, tree: PyTree,
     newest.  Returns the checkpoint path."""
     os.makedirs(directory, exist_ok=True)
     flat = _flatten(tree)
-    meta = {"step": int(step), "keys": sorted(flat), **(metadata or {})}
+    # computed entries LAST: user metadata must not clobber the keys
+    # restore correctness depends on (step, keys, vdtypes)
+    meta = {**(metadata or {}), "step": int(step),
+            "keys": sorted(flat), "vdtypes": _vdtype_names(flat)}
     path = os.path.join(directory, f"ckpt_{step}.npz")
     _atomic_savez(directory, path, meta, flat)
     _prune(directory, keep)
@@ -227,7 +254,10 @@ def restore_sharded_checkpoint(directory: str, like: PyTree,
                         tuple(glob["shape"]), np.dtype(glob["dtype"]))
                     regions[leaf_key] = []
                 idx = tuple(slice(a, b) for a, b in info["index"])
-                assembled[leaf_key][idx] = z[skey]
+                # extension-dtype shards load as void: view back to the
+                # recorded global dtype before assignment
+                assembled[leaf_key][idx] = _review_vdtype(
+                    z[skey], assembled[leaf_key].dtype)
                 regions[leaf_key].append(idx)
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
@@ -334,6 +364,9 @@ def restore_checkpoint(directory: str, like: PyTree, step: int | None = None
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
         flat = {k: z[k] for k in z.files if k != "__meta__"}
+    for k, name in meta.get("vdtypes", {}).items():
+        if k in flat:
+            flat[k] = _review_vdtype(flat[k], np.dtype(name))
 
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
@@ -341,8 +374,16 @@ def restore_checkpoint(directory: str, like: PyTree, step: int | None = None
         key = _SEP.join(_path_elem(p) for p in pathspec)
         if key not in flat:
             raise KeyError(f"checkpoint {path} missing leaf {key!r}")
-        arr = flat[key]
         want = np.asarray(jax.device_get(leaf))
+        arr = flat[key]
+        if (arr.dtype.kind == "V" and arr.dtype.fields is None
+                and key not in meta.get("vdtypes", {})
+                and want.dtype == np.dtype("bfloat16")):
+            # pre-vdtypes checkpoints carry no record; bfloat16 is the
+            # only 2-byte extension dtype, so the view is unambiguous —
+            # 1-byte voids (float8 family) stay a LOUD mismatch rather
+            # than a silent cross-dtype bit reinterpretation
+            arr = _review_vdtype(arr, want.dtype)
         if arr.shape != want.shape:
             raise ValueError(
                 f"leaf {key!r}: checkpoint shape {arr.shape} != {want.shape}")
