@@ -175,8 +175,10 @@ def save_sharded_checkpoint(directory: str, step: int, tree: PyTree,
                     tuple(slice(None) for _ in arr.shape), arr.shape)}
             shard_meta[f"{key}!"] = {"shape": list(arr.shape),
                                      "dtype": str(arr.dtype)}
-    meta = {"step": int(step), "process": int(process_index),
-            "shards": shard_meta, **(metadata or {})}
+    # computed entries LAST: user metadata must not clobber the keys
+    # reassembly depends on (step, process, shards)
+    meta = {**(metadata or {}), "step": int(step),
+            "process": int(process_index), "shards": shard_meta}
     path = os.path.join(directory, f"ckpt_{step}.shard{process_index}.npz")
     _atomic_savez(directory, path, meta, flat)
     if process_index == 0 and keep > 0:

@@ -19,6 +19,11 @@ from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
 def main():
     opt = parse_flags("EASGD worker client.", {
         **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
+        "autoRejoin": (1, "on a failed sync (server evicted this client, "
+                          "connection reset, timeout), re-dial and "
+                          "Rejoin? instead of crashing — local params "
+                          "reset to the CURRENT center, training "
+                          "continues.  --autoRejoin 0 = fail fast"),
     })
     setup_platform(1, opt.tpu)
 
@@ -26,6 +31,7 @@ def main():
     import numpy as np
     from jax import random
 
+    from distlearn_tpu.comm import ProtocolError
     from distlearn_tpu.data import PermutationSampler, batch_iterator
     from distlearn_tpu.models.core import loss_fn
     from distlearn_tpu.parallel.async_ea import AsyncEAClient
@@ -59,7 +65,22 @@ def main():
             rng, sub = random.split(rng)
             grads, mstate, loss = grad_step(params, mstate, bx, by, sub)
             # sync BETWEEN grads and update (EASGD_client.lua:109 then :113)
-            params, synced = client.sync_client(params)
+            try:
+                params, synced = client.sync_client(params)
+            except (OSError, ProtocolError) as e:
+                # OSError covers TimeoutError/ConnectionError.  An
+                # evicted/cut worker is not dead: re-admit and take the
+                # CURRENT center — and skip this iteration's update,
+                # whose gradient was computed at the stale params the
+                # reset just discarded (applying it would re-inject the
+                # lost state in gradient form)
+                if not opt.autoRejoin:
+                    raise
+                print_client(opt.nodeIndex,
+                             f"sync failed ({e!r}); rejoining")
+                params = client.rejoin(params)
+                step += 1
+                continue
             params = apply_sgd(params, grads)
             step += 1
             if synced:
