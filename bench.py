@@ -568,8 +568,20 @@ def multichip_suite(ar_mb: int = 64):
             print(f"[bench] pp_memory sweep failed: {e}", file=sys.stderr)
 
     if os.environ.get("BENCH_SKIP_SCALING") != "1":
+        budget = None                       # sweep's own default
+        deadline_ts = os.environ.get("BENCH_PROXY_DEADLINE_TS")
+        if deadline_ts:
+            remaining = float(deadline_ts) - time.time()
+            if remaining < 60.0:
+                print("[bench] skipping scaling sweep: <60s left before "
+                      "the proxy subprocess deadline", file=sys.stderr)
+                out["scaling_sweep"] = {"skipped": "proxy deadline"}
+                return out
+            budget = min(remaining, float(os.environ.get(
+                "BENCH_SCALING_BUDGET_S", "600")))
         try:
-            out["scaling_sweep"] = multichip_scaling_sweep()
+            out["scaling_sweep"] = multichip_scaling_sweep(
+                budget_s=budget)
         except Exception as e:  # noqa: BLE001 — trend is supplementary
             print(f"[bench] scaling sweep failed: {e}", file=sys.stderr)
     return out
@@ -838,6 +850,12 @@ def multichip_proxy_cpu(n: int = 8):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS=f"--xla_force_host_platform_device_count={n}")
     env.setdefault("BENCH_AR_MB", "16")
+    # absolute wall deadline for the SUPPLEMENTARY sections (the scaling
+    # sweep): whatever time the earlier suite rows consumed, the sweep
+    # only gets what remains before the subprocess kill below — losing
+    # the sweep is fine, losing every already-measured row to the kill
+    # is not.  150s slack covers teardown + JSON emit.
+    env["BENCH_PROXY_DEADLINE_TS"] = str(time.time() + 2700 - 150)
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--multichip-probe"],
