@@ -71,6 +71,10 @@ def main():
                          "update stays exact (train.build_lm_mixed_step / "
                          "build_lm_mixed_optax_step; not with --pp/--zero,"
                          " which manage their own param layouts)"),
+        "fsdp": (False, "ZeRO-3 / fully-sharded data parallelism: params "
+                        "LIVE sharded 1/dp per device, plain jit + GSPMD "
+                        "inserts the gathers (train.build_lm_fsdp_step; "
+                        "needs --sp 1 --tp 1, sgd, dense)"),
         "optimizer": ("sgd", "sgd | adam | adamw — non-sgd runs the "
                              "replicated-state optax step "
                              "(train.build_lm_optax_step; needs --tp 1)"),
@@ -101,6 +105,12 @@ def main():
         raise SystemExit("--mixed composes with the fused sgd/optax steps "
                          "(--pp stages and --zero shards manage their own "
                          "parameter layouts)")
+    if opt.fsdp and (opt.sp != 1 or opt.tp != 1 or opt.pp or opt.zero
+                     or opt.mixed or opt.moeExperts
+                     or opt.optimizer != "sgd"):
+        raise SystemExit("--fsdp shards the whole model over the data "
+                         "axis: pass --sp 1 --tp 1 and no "
+                         "--pp/--zero/--mixed/--moeExperts/--optimizer")
     if opt.pp:
         if opt.sp != 1 or opt.tp != 1:
             raise SystemExit("--pp composes with data parallelism only: "
@@ -254,6 +264,15 @@ def main():
                 params = LMOptaxState(placed, tx.init(placed))
                 log(f"{opt.optimizer} via the replicated-state optax "
                     "LM step")
+        elif opt.fsdp:
+            from distlearn_tpu.train import (build_lm_fsdp_step,
+                                             init_lm_fsdp_params)
+            step = build_lm_fsdp_step(lm, mesh, params,
+                                      lr=opt.learningRate,
+                                      accum_steps=opt.accumSteps)
+            params = init_lm_fsdp_params(params, mesh)
+            log("ZeRO-3/FSDP: params live sharded 1/dp per device; "
+                "jit+GSPMD inserts the gathers")
         elif opt.mixed:
             from distlearn_tpu.train import (build_lm_mixed_step,
                                              init_lm_mixed_state)

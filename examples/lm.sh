@@ -15,3 +15,4 @@ python lm.py --dp 2 --sp 1 --tp 1 --pp 4 --depth 8 --ppSchedule 1f1b "$@"
 python lm.py --dp 2 --sp 2 --tp 2 --zero --learningRate 0.003 "$@"
 python lm.py --dp 2 --sp 4 --tp 1 --seqLayout zigzag --rematMode mlp "$@"
 python lm.py --dp 2 --sp 2 --tp 2 --mixed "$@"
+python lm.py --dp 8 --sp 1 --tp 1 --fsdp "$@"

@@ -431,3 +431,115 @@ def test_lm_zero_state_checkpoint_roundtrip_resumes_training(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref.params)),
                     jax.tree_util.tree_leaves(jax.device_get(st2.params))):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lm_fsdp_step_matches_replicated_step():
+    """ZeRO-3 / FSDP (jit + GSPMD: params live sharded, XLA inserts the
+    gathers) must compute the SAME update as the replicated-param
+    shard_map step on the same global batch — the two TPU idioms are
+    numerically interchangeable."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import (build_lm_fsdp_step, build_lm_step,
+                                     init_lm_fsdp_params)
+
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+    L = 32
+    model = transformer_lm(vocab=32, dim=32, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    toks = np.random.RandomState(0).randint(0, 32, (n, L)).astype(np.int32)
+
+    ref_step = build_lm_step(model, mesh, params, lr=0.1, seq_axis=None,
+                             tp_axis=None, donate=False)
+    tok_ref = jax.device_put(toks, NamedSharding(mesh, P("data")))
+    p_ref, l_ref = ref_step(params, tok_ref)
+
+    placed = init_lm_fsdp_params(params, mesh)
+    # storage really is 1/n per device for every divisible leaf
+    any_sharded = False
+    for leaf in jax.tree_util.tree_leaves(placed):
+        shard = leaf.addressable_shards[0].data
+        if shard.size != leaf.size:
+            assert leaf.size == shard.size * n
+            any_sharded = True
+    assert any_sharded
+    fsdp_step = build_lm_fsdp_step(model, mesh, params, lr=0.1,
+                                   donate=False)
+    p_f, l_f = fsdp_step(placed, tok_ref)
+    np.testing.assert_allclose(float(l_f), float(l_ref), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref),
+                    jax.tree_util.tree_leaves(p_f)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_lm_fsdp_step_trains_donated():
+    """The production shape (donated sharded params): loss decreases and
+    the returned params keep their FSDP shardings across steps."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import build_lm_fsdp_step, init_lm_fsdp_params
+
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+    L = 32
+    model = transformer_lm(vocab=32, dim=64, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    step = build_lm_fsdp_step(model, mesh, params, lr=0.1)
+    p = init_lm_fsdp_params(params, mesh)
+    base = np.random.RandomState(0).randint(0, 32, (1, L)).astype(np.int32)
+    toks = jax.device_put(np.tile(base, (n, 1)),
+                          NamedSharding(mesh, P("data")))
+    losses = []
+    for _ in range(12):
+        p, loss = step(p, toks)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # returned params KEEP the 1/n storage (a regression to replicated
+    # out_shardings would silently defeat the ZeRO-3 memory claim)
+    any_sharded = False
+    for leaf in jax.tree_util.tree_leaves(p):
+        shard = leaf.addressable_shards[0].data
+        if shard.size != leaf.size:
+            assert leaf.size == shard.size * n
+            any_sharded = True
+    assert any_sharded
+
+
+def test_lm_fsdp_accum_matches_single_shot():
+    """accum_steps=k under FSDP: same update as the single-shot step
+    (equal microbatches — mean-of-means is the global mean)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import build_lm_fsdp_step, init_lm_fsdp_params
+
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("data",))
+    L = 32
+    model = transformer_lm(vocab=32, dim=32, depth=1, heads=2, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    toks = jax.device_put(
+        np.random.RandomState(0).randint(0, 32, (2 * n, L))
+        .astype(np.int32), NamedSharding(mesh, P("data")))
+    one = build_lm_fsdp_step(model, mesh, params, lr=0.1, donate=False)
+    two = build_lm_fsdp_step(model, mesh, params, lr=0.1, donate=False,
+                             accum_steps=2)
+    placed = init_lm_fsdp_params(params, mesh)
+    p1, l1 = one(placed, toks)
+    p2, l2 = two(placed, toks)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
