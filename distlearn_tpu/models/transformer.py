@@ -47,6 +47,31 @@ def _rmsnorm(params, x, eps=1e-6):
     return y * params["scale"].astype(x.dtype)
 
 
+def attn_qkv(blk: PyTree, x: jax.Array, cd, tp_axis: str | None = None):
+    """Pre-norm + q/k/v projections of one block — the ONE home of the
+    projection math, shared by :func:`attn_apply` (training forward) and
+    :func:`greedy_generate` (prefill + per-tick decode), so a future
+    change (bias terms, RoPE, QK-norm) cannot silently diverge between
+    training and generation."""
+    h = _rmsnorm(blk["ln1"], x)
+    if tp_axis is not None:   # enter column-parallel region ("f")
+        h = tp_enter(h, tp_axis)
+    q = jnp.einsum("ble,ehd->blhd", h, blk["wq"].astype(cd))
+    k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
+    v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
+    return q, k, v
+
+
+def attn_out(blk: PyTree, x: jax.Array, att: jax.Array, cd,
+             tp_axis: str | None = None) -> jax.Array:
+    """Output projection + residual (the other half shared with the
+    decoder)."""
+    proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))
+    if tp_axis is not None:   # heads were sharded: reduce ("g")
+        proj = tp_reduce(proj, tp_axis)
+    return x + proj
+
+
 def attn_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
                seq_axis: str | None = None, tp_axis: str | None = None,
                attn_impl: str | None = None):
@@ -55,20 +80,12 @@ def attn_apply(blk: PyTree, x: jax.Array, cd, *, seq_attn=None,
     selective-remat mode can checkpoint the FFN half alone (saving the
     attention output and the flash kernel's softmax residuals instead of
     re-running the attention forward in the backward pass)."""
-    h = _rmsnorm(blk["ln1"], x)
-    if tp_axis is not None:   # enter column-parallel region ("f")
-        h = tp_enter(h, tp_axis)
-    q = jnp.einsum("ble,ehd->blhd", h, blk["wq"].astype(cd))
-    k = jnp.einsum("ble,ehd->blhd", h, blk["wk"].astype(cd))
-    v = jnp.einsum("ble,ehd->blhd", h, blk["wv"].astype(cd))
+    q, k, v = attn_qkv(blk, x, cd, tp_axis)
     if seq_axis is not None:
         att = seq_attn(q, k, v, seq_axis, causal=True, impl=attn_impl)
     else:
         att = local_attention(q, k, v, causal=True, impl=attn_impl)
-    proj = jnp.einsum("blhd,hde->ble", att, blk["wo"].astype(cd))
-    if tp_axis is not None:   # heads were sharded: reduce ("g")
-        proj = tp_reduce(proj, tp_axis)
-    return x + proj
+    return attn_out(blk, x, att, cd, tp_axis)
 
 
 def ffn_apply(blk: PyTree, x: jax.Array, cd, *, tp_axis: str | None = None,
@@ -375,6 +392,102 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
 
     return Model(init=init, apply=apply, name="transformer_lm",
                  input_shape=(max_len,), num_classes=vocab)
+
+
+def greedy_generate(params: PyTree, tokens: jax.Array, steps: int,
+                    compute_dtype=None,
+                    attn_impl: str | None = None) -> jax.Array:
+    """KV-cached greedy decoding for a :func:`transformer_lm` parameter
+    tree (per-block layout): ``[B, P]`` prompt -> ``[B, steps]``
+    generated ids.
+
+    The training stack is forward/backward only (the reference is a
+    training framework); this is the inference half of the LM family —
+    one prefill pass caches every block's K/V (same math as
+    :func:`attn_apply`, with the projections exposed so the cache can be
+    captured), then a ``lax.scan`` emits one token per tick: each tick
+    computes ONE position's q/k/v, appends to the cache with a
+    ``dynamic_update_slice``, and attends over the cache under a static
+    position mask — static shapes throughout, so the whole decode is one
+    compiled program (no per-token retrace, no O(T^2) recompute of the
+    naive re-run-the-prefix rollout).  DENSE blocks only: per-tick MoE
+    routing would compute expert capacity over one token instead of the
+    full batch×length the model trained with — a different model, so it
+    is rejected rather than silently approximated.  Scanned-layout trees
+    (``"blocks"``) are unstacked automatically.  ``attn_impl`` should
+    match the model's kernel (float-level kernel differences can flip
+    argmax at near-tie logits).  Greedy (argmax) sampling.
+
+    Equivalence to the no-cache rollout is tested
+    (tests/test_transformer.py).
+    """
+    if "blocks" in params:
+        d = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+        params = unstack_block_params(params, d)
+    depth = sum(1 for k in params if k.startswith("block"))
+    for i in range(depth):
+        if "router" in params[f"block{i}"]:
+            raise ValueError(
+                "greedy_generate supports dense blocks only: per-tick "
+                "MoE routing computes capacity over ONE token, not the "
+                "batch the router trained with (block"
+                f"{i} has a router)")
+    cd = compute_dtype or params["embed"].dtype
+    B, P = tokens.shape
+    T = P + steps
+    if T > params["pos"].shape[0]:
+        raise ValueError(f"prompt + steps = {T} exceeds max_len "
+                         f"{params['pos'].shape[0]}")
+
+    # ---- prefill: full causal pass, caches seeded with the prompt K/V
+    x = params["embed"][tokens].astype(cd)
+    x = x + params["pos"][:P].astype(cd)[None]
+    caches = []
+    for i in range(depth):
+        blk = params[f"block{i}"]
+        q, k, v = attn_qkv(blk, x, cd)
+        ck = jnp.zeros((B, T) + k.shape[2:], k.dtype)
+        cv = jnp.zeros((B, T) + v.shape[2:], v.dtype)
+        caches.append((lax.dynamic_update_slice_in_dim(ck, k, 0, 1),
+                       lax.dynamic_update_slice_in_dim(cv, v, 0, 1)))
+        att = local_attention(q, k, v, causal=True, impl=attn_impl)
+        x = attn_out(blk, x, att, cd)
+        x = ffn_apply(blk, x, cd)
+    x = _rmsnorm(params["out_norm"], x)
+    logits = (x[:, -1] @ params["embed"].T.astype(cd)).astype(jnp.float32)
+    first = jnp.argmax(logits, axis=-1)            # [B]
+
+    def decode(carry, _):
+        tok, pos, caches = carry                   # tok [B], pos scalar
+        x = params["embed"][tok].astype(cd)[:, None]
+        x = x + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                         0).astype(cd)[None]
+        new_caches = []
+        for i in range(depth):
+            blk = params[f"block{i}"]
+            ck, cv = caches[i]
+            q, k1, v1 = attn_qkv(blk, x, cd)       # [B,1,H,D]
+            ck = lax.dynamic_update_slice_in_dim(ck, k1, pos, 1)
+            cv = lax.dynamic_update_slice_in_dim(cv, v1, pos, 1)
+            new_caches.append((ck, cv))
+            D = q.shape[-1]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                           preferred_element_type=jnp.float32)
+            s = s * (1.0 / (D ** 0.5))
+            live = jnp.arange(T)[None, None, None, :] <= pos
+            s = jnp.where(live, s, -jnp.inf)
+            w = jax.nn.softmax(s, axis=-1)
+            att = jnp.einsum("bhqk,bkhd->bqhd", w.astype(cd), cv)
+            x = attn_out(blk, x, att, cd)
+            x = ffn_apply(blk, x, cd)
+        x = _rmsnorm(params["out_norm"], x)
+        lg = (x[:, 0] @ params["embed"].T.astype(cd)).astype(jnp.float32)
+        nxt = jnp.argmax(lg, axis=-1)
+        return (nxt, pos + 1, new_caches), tok
+
+    (_, _, _), out = lax.scan(decode, (first, jnp.int32(P), caches),
+                              None, length=steps)
+    return jnp.swapaxes(out, 0, 1)                 # [B, steps]
 
 
 def stack_block_params(params: PyTree, depth: int) -> PyTree:

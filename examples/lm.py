@@ -75,6 +75,10 @@ def main():
                         "LIVE sharded 1/dp per device, plain jit + GSPMD "
                         "inserts the gathers (train.build_lm_fsdp_step; "
                         "needs --sp 1 --tp 1, sgd, dense)"),
+        "generate": (0, "after training, greedy-decode this many tokens "
+                        "from a held-out prompt with the KV-cached "
+                        "decoder (models.greedy_generate; single-replica "
+                        "param layouts: not --pp/--zero/--fsdp)"),
         "optimizer": ("sgd", "sgd | adam | adamw — non-sgd runs the "
                              "replicated-state optax step "
                              "(train.build_lm_optax_step; needs --tp 1)"),
@@ -349,6 +353,27 @@ def main():
                 log(f"step {i}: loss {float(loss):.4f}{extra} "
                     f"({timer.steps_per_sec():.2f} steps/s)")
     jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+    if opt.generate:
+        if opt.pp or opt.zero or opt.fsdp:
+            raise SystemExit("--generate needs a single-replica param "
+                             "layout (not --pp/--zero/--fsdp)")
+        if opt.moeExperts:
+            raise SystemExit("--generate supports dense models (per-tick "
+                             "MoE routing would not match the trained "
+                             "capacity math)")
+        if opt.seqLayout == "zigzag":
+            raise SystemExit("--generate decodes in natural order — drop "
+                             "--seqLayout zigzag")
+        from distlearn_tpu.models import greedy_generate
+        # the trained params: unwrap mixed/optax states to the plain tree
+        p = getattr(params, "params", params)
+        prompt = jnp.asarray(toks[:1, : max(4, opt.seqLen // 8)])
+        gen = greedy_generate(p, prompt,
+                              min(opt.generate,
+                                  opt.seqLen - prompt.shape[1]),
+                              attn_impl=opt.attnImpl or None)
+        log(f"generated {gen.shape[1]} tokens (KV-cached greedy): "
+            f"{np.asarray(gen[0]).tolist()}")
     log("done")
 
 

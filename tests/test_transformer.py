@@ -215,3 +215,77 @@ def test_scan_blocks_rejects_moe():
     with pytest.raises(ValueError, match="scan_blocks"):
         transformer_lm(vocab=8, dim=8, depth=2, heads=1, scan_blocks=True,
                        moe_experts=2)
+
+
+def test_greedy_generate_matches_no_cache_rollout():
+    """The KV-cached decode must emit the SAME tokens as the naive
+    rollout (re-run the full forward on the growing sequence, argmax the
+    last position each time) — the cache is an optimization, not a
+    different model."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distlearn_tpu.models.transformer import (greedy_generate,
+                                                  transformer_lm)
+
+    model = transformer_lm(vocab=43, dim=32, depth=2, heads=2, max_len=48)
+    params, _ = model.init(jax.random.PRNGKey(3))
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 43, (2, 8)).astype(np.int32)
+    steps = 12
+
+    # naive rollout oracle
+    seq = jnp.asarray(prompt)
+    naive = []
+    for _ in range(steps):
+        logits, _ = model.apply(params, {}, seq, train=False)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        naive.append(np.asarray(nxt))
+        seq = jnp.concatenate([seq, nxt[:, None].astype(jnp.int32)], 1)
+    naive = np.stack(naive, axis=1)                 # [B, steps]
+
+    got = np.asarray(greedy_generate(params, jnp.asarray(prompt), steps))
+    np.testing.assert_array_equal(got, naive)
+
+
+def test_greedy_generate_rejects_overlong():
+    import jax
+    import numpy as np
+    import pytest as _pytest
+
+    from distlearn_tpu.models.transformer import (greedy_generate,
+                                                  transformer_lm)
+
+    model = transformer_lm(vocab=17, dim=32, depth=1, heads=2, max_len=16)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    with _pytest.raises(ValueError, match="max_len"):
+        greedy_generate(params, np.zeros((1, 10), np.int32), 10)
+
+
+def test_greedy_generate_scanned_layout_and_moe_gate():
+    """Scanned-layout trees unstack automatically; MoE trees are
+    rejected loudly (per-tick routing would not match the trained
+    capacity math)."""
+    import jax
+    import numpy as np
+    import pytest as _pytest
+
+    from distlearn_tpu.models.transformer import (greedy_generate,
+                                                  stack_block_params,
+                                                  transformer_lm)
+
+    model = transformer_lm(vocab=43, dim=32, depth=2, heads=2, max_len=48)
+    params, _ = model.init(jax.random.PRNGKey(3))
+    prompt = np.random.RandomState(0).randint(0, 43, (1, 8)) \
+        .astype(np.int32)
+    want = np.asarray(greedy_generate(params, prompt, 6))
+    scanned = stack_block_params(params, 2)
+    got = np.asarray(greedy_generate(scanned, prompt, 6))
+    np.testing.assert_array_equal(got, want)
+
+    moe = transformer_lm(vocab=43, dim=32, depth=2, heads=2, max_len=48,
+                         moe_experts=2)
+    mp, _ = moe.init(jax.random.PRNGKey(0))
+    with _pytest.raises(ValueError, match="dense"):
+        greedy_generate(mp, prompt, 4)
