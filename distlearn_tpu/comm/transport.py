@@ -236,9 +236,15 @@ class Conn:
                 f"{dtype}{shape}")
         if out is not None:
             if out.dtype != dtype or out.shape != shape:
-                raise ValueError(
-                    f"recv buffer mismatch: have {out.dtype}{out.shape}, "
-                    f"got {dtype}{shape}")
+                # Drain the announced payload BEFORE raising: leaving nbytes
+                # unread would desync the stream, and the next recv on this
+                # connection would parse tensor data as a frame header.
+                self._recv_exact(nbytes, mid_frame=True)
+                raise ProtocolError(
+                    f"recv buffer mismatch: caller expects "
+                    f"{out.dtype}{out.shape} but the wire header announces "
+                    f"{dtype}{shape} — sender and receiver disagree on the "
+                    "tensor schedule (rank model/config skew)")
             if not (out.flags.c_contiguous and out.flags.writeable):
                 tmp = np.empty(shape, dtype)
                 self._recv_exact(nbytes, memoryview(tmp).cast("B"),

@@ -1,0 +1,21 @@
+"""distlint: static SPMD/collective and host-communication linting.
+
+Two analysis families share the :class:`~distlearn_tpu.lint.core.Finding`
+vocabulary:
+
+* :mod:`distlearn_tpu.lint.spmd` — abstractly traces a step function to a
+  closed jaxpr and walks it (through ``cond``/``scan``/``while``/
+  ``shard_map``/``pjit``) checking the collective rules DL001–DL005.
+* :mod:`distlearn_tpu.lint.protocol` — models the host-side send/recv
+  schedules of ``comm.tree``/``comm.ring`` and the AsyncEA handshake as
+  per-rank message sequences and searches them for wait-for cycles, plus an
+  AST audit of lock usage in the threaded paths (DL101–DL104).
+
+``tools/distlint.py`` is the CLI front end; ``lint.registry`` names the
+repo's step-function families so CI can lint all of them in one call.
+"""
+
+from distlearn_tpu.lint.core import Finding, RULES, format_findings
+from distlearn_tpu.lint.spmd import lint_step, lint_jaxpr
+
+__all__ = ["Finding", "RULES", "format_findings", "lint_step", "lint_jaxpr"]

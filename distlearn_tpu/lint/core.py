@@ -1,0 +1,90 @@
+"""Shared finding vocabulary for distlint.
+
+A :class:`Finding` is one rule violation at one program point.  Rules are
+identified by stable IDs (``DL0xx`` for jaxpr-level SPMD rules, ``DL1xx``
+for host-communication rules) so they can be suppressed individually —
+per call (``suppress={"DL004"}``), per registry entry, or from the CLI
+(``--disable DL004``).  docs/LINT.md is the rule catalog.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+#: Rule catalog: id -> (title, default severity).
+RULES = {
+    "DL001": ("collective over an axis name not bound by any enclosing "
+              "mesh/shard_map", "error"),
+    "DL002": ("collectives diverge across branches of a data-dependent "
+              "cond/while (cross-device deadlock hazard)", "error"),
+    "DL003": ("PRNG key consumed under shard_map without per-device "
+              "fold_in (every device draws identical randomness)", "error"),
+    "DL004": ("cross-device reduction accumulates in a <32-bit float "
+              "dtype", "error"),
+    "DL005": ("donated input buffer has no shape/dtype-compatible output "
+              "to alias (donation is wasted or unsafe)", "error"),
+    "DL101": ("host send/recv schedule admits a wait-for cycle "
+              "(static deadlock)", "error"),
+    "DL102": ("lock acquisition order forms a cycle across threads",
+              "error"),
+    "DL103": ("blocking network/queue call while holding a lock", "error"),
+    "DL104": ("peers disagree on message order (protocol desync)", "error"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.
+
+    ``where`` is a human-readable program point: for SPMD rules a path of
+    nested jaxprs (``"step/shard_map/cond[branch 1]"``), for protocol rules
+    a rank or source location.
+    """
+
+    rule: str
+    message: str
+    where: str = ""
+    severity: str = "error"
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown rule id {self.rule!r}")
+
+    def __str__(self) -> str:
+        loc = f" [{self.where}]" if self.where else ""
+        return f"{self.rule}{loc}: {self.message}"
+
+
+def filter_suppressed(findings: Iterable[Finding],
+                      suppress: Iterable[str] = ()) -> list[Finding]:
+    """Drop findings whose rule id is suppressed (unknown ids rejected)."""
+    suppress = set(suppress)
+    bad = suppress - RULES.keys()
+    if bad:
+        raise ValueError(f"cannot suppress unknown rule(s): {sorted(bad)}")
+    return [f for f in findings if f.rule not in suppress]
+
+
+def format_findings(findings: Sequence[Finding], *, header: str = "") -> str:
+    """Render findings for terminal output, one per line."""
+    lines = []
+    if header:
+        lines.append(header)
+    if not findings:
+        lines.append("  no findings")
+    for f in findings:
+        lines.append(f"  {f.severity.upper()} {f}")
+    return "\n".join(lines)
+
+
+@dataclass
+class LintResult:
+    """Findings for one lintable unit (a step function or a protocol)."""
+
+    name: str
+    findings: list[Finding] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not any(f.severity == "error" for f in self.findings)

@@ -1,0 +1,376 @@
+"""Host-communication protocol checker (rules DL101-DL104).
+
+Two independent analyses:
+
+**Schedule simulation** — the blocking send/recv sequence each rank
+executes in ``comm/tree.py`` / ``comm/ring.py`` / the AsyncEA handshake is
+written down as a list of :class:`Op` per rank (the schedule builders here
+derive topology from the same helpers the implementations use, so they
+track the real code).  :func:`check_schedules` then executes all ranks
+against each other: an op fires when its counterpart is ready, and when no
+rank can make progress the wait-for graph is extracted and reported —
+a cycle is DL101 (static deadlock), a rank waiting on a terminated peer is
+starvation (also DL101).  ``buffered_sends`` selects the transport model:
+``True`` matches the repo's transports (OS socket buffers + the ring's
+``_Sender`` thread make sends asynchronous), ``False`` models rendezvous
+sends — under which the ring schedule deadlocks, which is exactly why
+``ring.py`` owns a sender thread.  Tag mismatches on delivery are DL104:
+the peers disagree on message *order*, which on the wire shows up as a
+header parsed as payload.
+
+**Lock audit** — an AST walk over the threaded modules
+(``comm/transport.py``, ``parallel/async_ea.py``).  Nested ``with
+<lock>:`` statements contribute edges to a lock-order graph; a cycle
+across the whole audited set is DL102.  A blocking network call
+(``recv_msg``/``send_tensor``/``accept``/...) issued while holding a lock
+is DL103 — it extends lock hold times by a network round-trip and, when
+the peer needs the same lock to answer, deadlocks.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+from collections import deque
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Sequence
+
+from distlearn_tpu.lint.core import Finding
+
+__all__ = [
+    "Op", "send", "recv", "recv_any",
+    "tree_allreduce_schedule", "ring_allreduce_schedule",
+    "async_ea_sync_schedule", "check_schedules", "lock_order_audit",
+]
+
+
+@dataclass(frozen=True)
+class Op:
+    """One blocking endpoint operation in a rank's schedule."""
+
+    kind: str           # 'send' | 'recv' | 'recv_any'
+    peer: object = None  # rank id; None for recv_any
+    tag: str = ""       # message label, checked on delivery (DL104)
+
+
+def send(peer, tag=""):
+    return Op("send", peer, tag)
+
+
+def recv(peer, tag=""):
+    return Op("recv", peer, tag)
+
+
+def recv_any(tag=""):
+    return Op("recv_any", None, tag)
+
+
+# ---------------------------------------------------------------------------
+# Schedule builders for the repo's protocols.
+
+def tree_allreduce_schedule(num_nodes: int, base: int = 2) -> dict:
+    """Per-rank op sequence of ``Tree.all_reduce_ex`` (up fold, parent
+    exchange, down fan-out) on the same topology ``comm.tree`` builds."""
+    from distlearn_tpu.comm.tree import _children, _parent
+    sched = {}
+    for r in range(num_nodes):
+        ops = []
+        for kid in _children(r, base, num_nodes):
+            ops.append(recv(kid, "up"))
+        if r != 0:
+            p = _parent(r, base)
+            ops.append(send(p, "up"))
+            ops.append(recv(p, "down"))
+        for kid in _children(r, base, num_nodes):
+            ops.append(send(kid, "down"))
+        sched[r] = ops
+    return sched
+
+
+def ring_allreduce_schedule(num_nodes: int) -> dict:
+    """Per-rank op sequence of ``Ring._ring_allreduce_flat``: N-1
+    reduce-scatter steps then N-1 allgather steps, send-to-successor
+    before recv-from-predecessor each step (full duplex on the wire)."""
+    n = num_nodes
+    sched = {}
+    for r in range(n):
+        succ, pred = (r + 1) % n, (r - 1) % n
+        ops = []
+        for phase in ("rs", "ag"):
+            for s in range(n - 1):
+                ops.append(send(succ, f"{phase}{s}"))
+                ops.append(recv(pred, f"{phase}{s}"))
+        sched[r] = ops
+    return sched
+
+
+def async_ea_sync_schedule(num_leaves: int = 2, *, client_order=None) -> dict:
+    """One AsyncEA sync round between the serial server ``S`` and one
+    client ``C`` (``AsyncEAServer.sync_server`` / ``AsyncEAClient.sync``).
+
+    ``client_order`` overrides the client's question order — the linter's
+    known-bad configuration swaps ``Center?``/``delta?`` to demonstrate the
+    DL104 desync such an edit would introduce.
+    """
+    L = num_leaves
+    server = ([recv_any("Enter?"), send("C", "Enter"), recv("C", "Center?")]
+              + [send("C", "center")] * L
+              + [recv("C", "delta?"), send("C", "delta")]
+              + [recv("C", "delta_t")] * L)
+    order = client_order or ("Center?", "delta?")
+    client = [send("S", "Enter?"), recv("S", "Enter"), send("S", order[0])]
+    client += [recv("S", "center")] * L
+    client += [send("S", order[1]), recv("S", "delta")]
+    client += [send("S", "delta_t")] * L
+    return {"S": server, "C": client}
+
+
+# ---------------------------------------------------------------------------
+# The simulator.
+
+def check_schedules(schedules: Mapping, *, buffered_sends: bool = True,
+                    name: str = "protocol") -> list[Finding]:
+    """Execute all ranks' schedules against each other; report DL101 on
+    global no-progress (with the wait-for cycle) and DL104 on deliveries
+    whose tag differs from what the receiver expects."""
+    findings: list[Finding] = []
+    pc = {r: 0 for r in schedules}
+    chan: dict = {}  # (src, dst) -> deque of tags, buffered mode only
+
+    def cur(r):
+        ops = schedules[r]
+        return ops[pc[r]] if pc[r] < len(ops) else None
+
+    def deliver(r, op, tag, src):
+        if op.tag and tag != op.tag:
+            findings.append(Finding(
+                "DL104",
+                f"rank {r} expected {op.tag!r} from rank {src} but the "
+                f"next message is {tag!r}; the peers disagree on message "
+                "order and will misparse the stream",
+                where=f"{name}/rank {r}"))
+        pc[r] += 1
+
+    progress = True
+    while progress:
+        progress = False
+        for r in list(schedules):
+            op = cur(r)
+            if op is None:
+                continue
+            if op.kind == "send":
+                if buffered_sends:
+                    chan.setdefault((r, op.peer), deque()).append(op.tag)
+                    pc[r] += 1
+                    progress = True
+                else:
+                    peer_op = cur(op.peer)
+                    if peer_op is not None and (
+                            (peer_op.kind == "recv" and peer_op.peer == r)
+                            or peer_op.kind == "recv_any"):
+                        deliver(op.peer, peer_op, op.tag, r)
+                        pc[r] += 1
+                        progress = True
+            elif op.kind == "recv":
+                q = chan.get((op.peer, r))
+                if q:
+                    deliver(r, op, q.popleft(), op.peer)
+                    progress = True
+            elif op.kind == "recv_any":
+                for (src, dst), q in chan.items():
+                    if dst == r and q:
+                        deliver(r, op, q.popleft(), src)
+                        progress = True
+                        break
+
+    stuck = {r: cur(r) for r in schedules if cur(r) is not None}
+    if stuck:
+        findings.append(_deadlock_finding(stuck, pc, name))
+    return findings
+
+
+def _deadlock_finding(stuck, pc, name) -> Finding:
+    waits = {r: op.peer for r, op in stuck.items()}  # None for recv_any
+    cycle = _find_cycle(waits)
+    if cycle:
+        path = " -> ".join(str(r) for r in cycle + [cycle[0]])
+        detail = f"wait-for cycle {path}"
+    else:
+        detail = ", ".join(
+            f"rank {r} blocked at op {pc[r]} ({op.kind} "
+            f"{'' if op.peer is None else op.peer} {op.tag!r})"
+            for r, op in stuck.items())
+    return Finding(
+        "DL101",
+        f"schedule cannot complete: {detail}; "
+        f"{len(stuck)} rank(s) permanently blocked",
+        where=name)
+
+
+def _find_cycle(waits: Mapping):
+    for start in waits:
+        seen: dict = {}
+        r = start
+        while r in waits and waits[r] is not None:
+            if r in seen:
+                cyc = list(seen)[list(seen).index(r):]
+                return cyc
+            seen[r] = True
+            r = waits[r]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Lock audit (AST).
+
+#: Calls that block on the network or another thread.  dict.get / queue
+#: get_nowait style accessors are deliberately excluded.
+_BLOCKING_CALLS = frozenset({
+    "recv_msg", "recv_tensor", "send_msg", "send_tensor",
+    "accept", "recv_any", "select", "connect",
+})
+
+
+def _lock_name(expr, class_name):
+    """A with-item that looks like a lock acquisition, else None."""
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        return (class_name, expr.attr)
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return (class_name, expr.id)
+    return None
+
+
+class _LockVisitor(ast.NodeVisitor):
+    def __init__(self, filename):
+        self.filename = filename
+        self.class_name = ""
+        self.stack: list = []           # locks currently held (lexically)
+        self.edges: dict = {}           # (outer, inner) -> first location
+        self.blocking: list = []        # (lock, call name, location)
+
+    def visit_ClassDef(self, node):
+        prev, self.class_name = self.class_name, node.name
+        self.generic_visit(node)
+        self.class_name = prev
+
+    def visit_FunctionDef(self, node):
+        # A nested def runs on its own thread/later; locks held lexically
+        # outside it are not held at its call time.
+        prev, self.stack = self.stack, []
+        self.generic_visit(node)
+        self.stack = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            lock = _lock_name(item.context_expr, self.class_name)
+            if lock is not None:
+                loc = f"{self.filename}:{node.lineno}"
+                for held in self.stack:
+                    self.edges.setdefault((held, lock), loc)
+                self.stack.append(lock)
+                acquired.append(lock)
+        self.generic_visit(node)
+        for _ in acquired:
+            self.stack.pop()
+
+    def visit_Call(self, node):
+        if self.stack:
+            fn = node.func
+            cname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if cname in _BLOCKING_CALLS:
+                self.blocking.append(
+                    (self.stack[-1], cname, f"{self.filename}:{node.lineno}"))
+        self.generic_visit(node)
+
+
+def lock_order_audit(targets: Iterable, *, name: str = "locks") -> list[Finding]:
+    """DL102/DL103 audit over modules (or raw source strings).
+
+    All targets contribute to ONE lock-order graph: a cycle that only
+    exists across two modules (thread A in one file, thread B in another)
+    is still a deadlock.
+    """
+    edges: dict = {}
+    findings: list[Finding] = []
+    for t in targets:
+        if isinstance(t, str):
+            src, fname = t, "<string>"
+        else:
+            src, fname = inspect.getsource(t), getattr(t, "__name__", "?")
+        v = _LockVisitor(fname)
+        v.visit(ast.parse(src))
+        edges.update({k: loc for k, loc in v.edges.items() if k not in edges})
+        for lock, call, loc in v.blocking:
+            findings.append(Finding(
+                "DL103",
+                f"blocking call {call}() while holding lock "
+                f"{'.'.join(filter(None, lock))}; a slow or deadlocked peer "
+                "stalls every thread contending for this lock",
+                where=loc))
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    cycle = _digraph_cycle(graph)
+    if cycle:
+        path = " -> ".join(".".join(filter(None, l)) for l in cycle)
+        locs = sorted({edges[e] for e in zip(cycle, cycle[1:])
+                       if e in edges})
+        findings.append(Finding(
+            "DL102",
+            f"lock acquisition order forms a cycle: {path} "
+            f"(acquisition sites: {', '.join(locs)}); two threads taking "
+            "the locks in opposite order deadlock",
+            where=name))
+    return findings
+
+
+def _digraph_cycle(graph: Mapping):
+    """First cycle in a digraph as a node path [a, b, ..., a], else None."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    path: list = []
+
+    def dfs(n):
+        color[n] = GREY
+        path.append(n)
+        for m in graph.get(n, ()):
+            if color.get(m, WHITE) == GREY:
+                return path[path.index(m):] + [m]
+            if color.get(m, WHITE) == WHITE:
+                got = dfs(m)
+                if got:
+                    return got
+        path.pop()
+        color[n] = BLACK
+        return None
+
+    for n in list(graph):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got:
+                return got
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Repo-facing entry: lint every protocol the comm layer ships.
+
+def lint_comm_protocols(*, num_nodes: int = 7) -> list[Finding]:
+    """Check the real tree/ring/AsyncEA schedules (buffered transport, as
+    deployed) and audit the threaded modules' lock usage."""
+    findings = []
+    findings += check_schedules(tree_allreduce_schedule(num_nodes),
+                                name="tree.all_reduce")
+    findings += check_schedules(ring_allreduce_schedule(num_nodes),
+                                name="ring.all_reduce")
+    findings += check_schedules(async_ea_sync_schedule(),
+                                name="async_ea.sync")
+    from distlearn_tpu.comm import ring, transport, tree
+    from distlearn_tpu.parallel import async_ea
+    findings += lock_order_audit([transport, tree, ring, async_ea],
+                                 name="comm-threads")
+    return findings

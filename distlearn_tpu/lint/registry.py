@@ -1,0 +1,234 @@
+"""Named step-function families for distlint.
+
+Each :class:`Entry` knows how to build one family's step functions on a
+small mesh over the *available* devices and lint every one of them.  The
+registry is what ``tools/distlint.py --family sgd`` and the tier-1 gate
+test iterate over, so adding a builder here is how a new train step opts
+into CI linting.
+
+Callers must provide >= :data:`MIN_DEVICES` devices (the test conftest and
+the CLI both force 8 virtual CPU devices before jax initialises).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from distlearn_tpu.lint.core import Finding, LintResult, filter_suppressed
+
+__all__ = ["Entry", "MIN_DEVICES", "families", "run_family", "run_all"]
+
+MIN_DEVICES = 8
+
+
+@dataclass(frozen=True)
+class Entry:
+    name: str
+    description: str
+    run: Callable[[], list[tuple[str, list[Finding]]]]
+
+
+def _mnist_setup(num_nodes=2):
+    import jax
+    from jax import random
+    from distlearn_tpu.models import mnist_cnn
+    from distlearn_tpu.parallel.mesh import MeshTree
+    tree = MeshTree(num_nodes=num_nodes)
+    model = mnist_cnn()
+    return jax, random, model, tree
+
+
+def _sgd_family():
+    from distlearn_tpu.lint.spmd import lint_step
+    jax, random, model, tree = _mnist_setup()
+    from distlearn_tpu.train import (build_eval_step, build_sgd_scan_step,
+                                     build_sgd_step, build_sync_step,
+                                     init_train_state)
+    ts = init_train_state(model, tree, random.PRNGKey(0), 10)
+    x = jax.ShapeDtypeStruct((8, 32, 32, 1), "float32")
+    y = jax.ShapeDtypeStruct((8,), "int32")
+    xs = jax.ShapeDtypeStruct((3, 8, 32, 32, 1), "float32")
+    ys = jax.ShapeDtypeStruct((3, 8), "int32")
+    units = [
+        ("sgd_step", build_sgd_step(model, tree, lr=0.1), (ts, x, y)),
+        ("sgd_scan_step", build_sgd_scan_step(model, tree, lr=0.1),
+         (ts, xs, ys)),
+        ("sync_step", build_sync_step(tree), (ts,)),
+        ("eval_step", build_eval_step(model, tree),
+         (ts.params, ts.model_state, ts.cm, x, y)),
+    ]
+    return [(n, lint_step(f, a, mesh=tree.mesh, name=n)) for n, f, a in units]
+
+
+def _ea_family():
+    from distlearn_tpu.lint.spmd import lint_step
+    jax, random, model, tree = _mnist_setup()
+    from distlearn_tpu.train import (build_ea_cycle, build_ea_steps,
+                                     init_ea_state)
+    ts = init_ea_state(model, tree, random.PRNGKey(0), 10)
+    x = jax.ShapeDtypeStruct((8, 32, 32, 1), "float32")
+    y = jax.ShapeDtypeStruct((8,), "int32")
+    xs = jax.ShapeDtypeStruct((4, 8, 32, 32, 1), "float32")
+    ys = jax.ShapeDtypeStruct((4, 8), "int32")
+    local_step, ea_round = build_ea_steps(model, tree, lr=0.1, alpha=0.5)
+    cycle = build_ea_cycle(model, tree, lr=0.1, alpha=0.5)
+    units = [
+        ("ea_local_step", local_step, (ts, x, y)),
+        ("ea_round", ea_round, (ts,)),
+        ("ea_cycle", cycle, (ts, xs, ys)),
+    ]
+    return [(n, lint_step(f, a, mesh=tree.mesh, name=n)) for n, f, a in units]
+
+
+def _lm_family():
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    from distlearn_tpu.lint.spmd import lint_step
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import build_lm_step
+    dp, sp, tp = 2, 2, 2
+    mesh = Mesh(np.array(jax.devices()[:dp * sp * tp]).reshape(dp, sp, tp),
+                ("data", "seq", "model"))
+    L = 16 * sp
+    model = transformer_lm(vocab=32, dim=32, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    step = build_lm_step(model, mesh, params, lr=0.1)
+    tokens = jax.ShapeDtypeStruct((2 * dp, L), "int32")
+    return [("lm_step",
+             lint_step(step, (params, tokens), mesh=mesh, name="lm_step"))]
+
+
+def _lm_mixed_family():
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    from distlearn_tpu.lint.spmd import lint_step
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import build_lm_mixed_step, init_lm_mixed_state
+    dp, sp, tp = 2, 2, 2
+    mesh = Mesh(np.array(jax.devices()[:dp * sp * tp]).reshape(dp, sp, tp),
+                ("data", "seq", "model"))
+    L = 16 * sp
+    model = transformer_lm(vocab=32, dim=32, depth=2, heads=4, max_len=L)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    st = init_lm_mixed_state(params)
+    # Default grad_dtype=f32 upcasts bf16 grads BEFORE the psum — the
+    # DL004-clean scheme docs/PERF.md motivates.
+    step = build_lm_mixed_step(model, mesh, params, lr=0.1)
+    tokens = jax.ShapeDtypeStruct((2 * dp, L), "int32")
+    return [("lm_mixed_step",
+             lint_step(step, (st, tokens), mesh=mesh, name="lm_mixed_step"))]
+
+
+def _pp_family():
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    from distlearn_tpu.lint.spmd import lint_step
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.train import (build_lm_pp_1f1b_step, build_lm_pp_step,
+                                     stack_blocks)
+    depth = 2
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "pipe"))
+    model = transformer_lm(vocab=64, dim=32, depth=depth, heads=2, max_len=16)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    shared, stacked = stack_blocks(params, depth)
+    tokens = jax.ShapeDtypeStruct((8, 16), "int32")
+    units = [
+        ("lm_pp_step", build_lm_pp_step(mesh, shared, stacked, lr=0.1,
+                                        num_microbatches=2)),
+        ("lm_pp_1f1b_step", build_lm_pp_1f1b_step(mesh, shared, stacked,
+                                                  lr=0.1,
+                                                  num_microbatches=2)),
+    ]
+    return [(n, lint_step(f, (shared, stacked, tokens), mesh=mesh, name=n))
+            for n, f in units]
+
+
+def _optax_family():
+    from distlearn_tpu.lint.spmd import lint_step
+    jax, random, model, tree = _mnist_setup()
+    import optax
+    from distlearn_tpu.train import (build_optax_step,
+                                     build_zero_optax_step,
+                                     init_optax_state, init_zero_state)
+    tx = optax.sgd(0.1, momentum=0.9)
+    ts = init_optax_state(model, tree, tx, random.PRNGKey(0), 10)
+    step = build_optax_step(model, tree, tx)
+    adam = optax.adam(1e-3)
+    zts = init_zero_state(model, tree, adam, random.PRNGKey(0), 10)
+    zstep = build_zero_optax_step(model, tree, adam)
+    x = jax.ShapeDtypeStruct((8, 32, 32, 1), "float32")
+    y = jax.ShapeDtypeStruct((8,), "int32")
+    units = [
+        ("optax_step", step, (ts, x, y)),
+        ("zero_optax_step", zstep, (zts, x, y)),
+    ]
+    return [(n, lint_step(f, a, mesh=tree.mesh, name=n)) for n, f, a in units]
+
+
+def _protocol_family():
+    from distlearn_tpu.lint.protocol import (async_ea_sync_schedule,
+                                             check_schedules,
+                                             lint_comm_protocols,
+                                             ring_allreduce_schedule,
+                                             tree_allreduce_schedule)
+    units = [("comm_protocols", lint_comm_protocols(num_nodes=7))]
+    # Cover the schedule space beyond the default size as well.
+    for n in (2, 3, 5, 8):
+        units.append((f"tree[{n}]",
+                      check_schedules(tree_allreduce_schedule(n),
+                                      name=f"tree[{n}]")))
+        units.append((f"ring[{n}]",
+                      check_schedules(ring_allreduce_schedule(n),
+                                      name=f"ring[{n}]")))
+    units.append(("async_ea[L=5]",
+                  check_schedules(async_ea_sync_schedule(num_leaves=5),
+                                  name="async_ea[L=5]")))
+    return units
+
+
+_FAMILIES = {
+    "sgd": Entry("sgd", "fused AllReduceSGD steps (sgd/scan/sync/eval)",
+                 _sgd_family),
+    "ea": Entry("ea", "elastic-averaging steps (local/round/cycle)",
+                _ea_family),
+    "lm": Entry("lm", "3D-parallel LM train step", _lm_family),
+    "lm_mixed": Entry("lm_mixed", "bf16-working/f32-master LM step",
+                      _lm_mixed_family),
+    "pp": Entry("pp", "pipeline-parallel LM steps (GPipe + 1F1B)",
+                _pp_family),
+    "optax": Entry("optax", "optax-backed data-parallel + ZeRO-sharded steps",
+                   _optax_family),
+    "protocol": Entry("protocol",
+                      "host comm schedules (tree/ring/AsyncEA) + lock audit",
+                      _protocol_family),
+}
+
+
+def families() -> dict[str, Entry]:
+    return dict(_FAMILIES)
+
+
+def run_family(name: str, *, suppress: Sequence[str] = ()) -> list[LintResult]:
+    """Lint one family; returns one :class:`LintResult` per step function."""
+    entry = _FAMILIES[name]
+    import jax
+    n = len(jax.devices())
+    if n < MIN_DEVICES:
+        raise RuntimeError(
+            f"distlint needs >= {MIN_DEVICES} devices to build the step "
+            f"families (got {n}); set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
+            "importing jax (tools/distlint.py does this)")
+    return [LintResult(f"{name}:{unit}", filter_suppressed(fs, suppress))
+            for unit, fs in entry.run()]
+
+
+def run_all(*, suppress: Sequence[str] = ()) -> list[LintResult]:
+    out = []
+    for name in _FAMILIES:
+        out += run_family(name, suppress=suppress)
+    return out

@@ -27,9 +27,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 from jax import lax, random
+
 from jax.sharding import PartitionSpec as P
 
 from distlearn_tpu.models.core import Model
+from distlearn_tpu.utils import compat
 from distlearn_tpu.parallel.sequence import (alltoall_attention,
                                              local_attention, ring_attention)
 from distlearn_tpu.parallel.tp import tp_enter, tp_reduce
@@ -320,7 +322,7 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
             my = lax.axis_index(seq_axis)
             if seq_layout == "zigzag":
                 # local shard = early stripe my ++ late stripe 2n-1-my
-                n_sh = lax.axis_size(seq_axis)
+                n_sh = compat.axis_size(seq_axis)
                 s_len = L // 2
                 pa = lax.dynamic_slice_in_dim(params["pos"], my * s_len,
                                               s_len)
@@ -572,7 +574,7 @@ def lm_loss(model: Model, params, tokens, seq_axis=None, tp_axis=None,
         nll = -jnp.take_along_axis(lp, targets[..., None], -1)[..., 0]
         loss = nll.mean()
         return loss + bal if bal is not None else loss
-    n = lax.axis_size(seq_axis)
+    n = compat.axis_size(seq_axis)
     my = lax.axis_index(seq_axis)
     L = tokens.shape[1]
     if seq_layout == "zigzag":

@@ -36,6 +36,8 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from distlearn_tpu.utils.compat import shard_map
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -196,7 +198,7 @@ class MeshTree:
         if cache_key not in self._jit_cache:
             in_specs = tuple(P(self.axis_name) for _ in range(n_node_args))
             out_specs = P() if out_replicated else P(self.axis_name)
-            mapped = jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+            mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False)
             self._jit_cache[cache_key] = jax.jit(mapped)
         return self._jit_cache[cache_key]
@@ -240,7 +242,7 @@ class MeshTree:
 
     def spmd(self, fn: Callable, in_specs, out_specs, static_argnums=()):
         """shard_map + jit a step function over this mesh (the hot path)."""
-        mapped = jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+        mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False)
         return jax.jit(mapped, static_argnums=static_argnums)
 

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distlearn_tpu.utils import compat
+
 PyTree = Any
 
 
@@ -183,7 +185,7 @@ def pipeline_1f1b(stage_fn: Callable, stage_params: PyTree,
     the gradient w.r.t. ``x`` (nonzero only on rank 0; backprop it
     through the embedding outside).
     """
-    S = lax.axis_size(axis_name)
+    S = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B = x.shape[0]
     M = num_microbatches

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distlearn_tpu.utils import compat
+
 
 def _block_attn(q, k, v, scale, mask):
     """Scores + masked online-softmax partials for one K/V block.
@@ -87,7 +89,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if layout not in ("contig", "zigzag"):
         raise ValueError(f"layout must be 'contig' or 'zigzag', "
                          f"got {layout!r}")
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     if layout == "zigzag" and causal and n > 1:
         if q.shape[1] % 2:
             raise ValueError(
@@ -255,7 +257,7 @@ def alltoall_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q/k/v: local shards ``[B, L_local, H, D]`` (global sequence = rank-order
     concatenation over the axis).  Returns ``[B, L_local, H, D]``.
     """
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     if n == 1:
         return local_attention(q, k, v, causal=causal, impl=impl)
     H = q.shape[2]

@@ -13,6 +13,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from distlearn_tpu.utils.compat import shard_map
+
 from distlearn_tpu.models.core import Model
 from distlearn_tpu.models.transformer import (_rmsnorm, block_apply, lm_loss,
                                               param_specs,
@@ -160,7 +162,7 @@ def build_lm_step(model: Model, mesh: Mesh, params_template, lr: float,
         return new_params, lax.pmean(loss, data_axis)
 
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
-    mapped = jax.shard_map(step, mesh=mesh,
+    mapped = shard_map(step, mesh=mesh,
                            in_specs=(pspecs, tok_spec),
                            out_specs=(pspecs, P()),
                            check_vma=False)
@@ -194,7 +196,7 @@ def build_lm_moe_metrics(model: Model, mesh: Mesh, params_template,
                 for k, v in out.items()}
 
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         metrics, mesh=mesh, in_specs=(pspecs, tok_spec),
         out_specs={"moe_balance_loss": P(), "moe_dropped_frac": P()},
         check_vma=False))
@@ -325,7 +327,7 @@ def build_lm_pp_step(mesh: Mesh, shared_template, stacked_template,
             stacked, g_blk)
         return shared, stacked_new, lax.pmean(loss, data_axis)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(), P(pipe_axis), P(data_axis)),
         out_specs=(P(), P(pipe_axis), P()),
@@ -429,7 +431,7 @@ def build_lm_pp_1f1b_step(mesh: Mesh, shared_template, stacked_template,
             stacked, g_blk)
         return shared, stacked_new, lax.pmean(loss, data_axis)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         step, mesh=mesh,
         in_specs=(P(), P(pipe_axis), P(data_axis)),
         out_specs=(P(), P(pipe_axis), P()),
@@ -517,7 +519,7 @@ def build_lm_mixed_step(model: Model, mesh: Mesh, params_template, lr: float,
 
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
     spec = LMMixedState(params=pspecs, master=pspecs)
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
+    mapped = shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
                            out_specs=(spec, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -583,12 +585,12 @@ def build_lm_ea_steps(model: Model, tree, lr: float, alpha: float,
 
     spec = LMEAState(params=P(axis), center=P(axis), vel=P(axis))
     local = jax.jit(
-        jax.shard_map(local_step, mesh=tree.mesh,
+        shard_map(local_step, mesh=tree.mesh,
                       in_specs=(spec, P(axis)),
                       out_specs=(spec, P(axis)), check_vma=False),
         donate_argnums=(0,) if donate else ())
     rnd = jax.jit(
-        jax.shard_map(ea_round, mesh=tree.mesh, in_specs=(spec,),
+        shard_map(ea_round, mesh=tree.mesh, in_specs=(spec,),
                       out_specs=spec, check_vma=False),
         donate_argnums=(0,) if donate else ())
     return local, rnd

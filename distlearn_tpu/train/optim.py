@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax import lax, random
 from jax.sharding import PartitionSpec as P
 
+from distlearn_tpu.utils.compat import shard_map
+
 from distlearn_tpu.models.core import Model, loss_fn
 from distlearn_tpu.ops import flatten as flatten_lib
 from distlearn_tpu.parallel import allreduce_sgd
@@ -133,7 +135,7 @@ def build_optax_step(model: Model, tree: MeshTree, tx,
 
     specs = OptaxTrainState(params=P(), model_state=P(), opt_state=P(),
                             sync=P(axis), cm=P(axis), rng=P())
-    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
+    mapped = shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
                                                            P(axis)),
                            out_specs=(specs, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -310,7 +312,7 @@ def build_lm_zero_step(model: Model, tree: MeshTree, tx,
                 lax.pmean(loss, axis))
 
     specs = LMZeroState(params=P(), master=P(axis), opt_state=P(axis))
-    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis)),
+    mapped = shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis)),
                            out_specs=(specs, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -366,7 +368,7 @@ def build_lm_optax_step(model: Model, mesh, tx,
 
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
     spec = LMOptaxState(params=P(), opt_state=P())
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
+    mapped = shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
                            out_specs=(spec, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -433,7 +435,7 @@ def build_lm_mixed_optax_step(model: Model, mesh, tx,
 
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
     spec = LMMixedOptaxState(params=P(), master=P(), opt_state=P())
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
+    mapped = shard_map(step, mesh=mesh, in_specs=(spec, tok_spec),
                            out_specs=(spec, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -585,7 +587,7 @@ def init_lm_zero_mesh_state(params, mesh, tx, data_axis: str = "data",
                 jax.tree_util.tree_map(exp, opt))
 
     out_spec = P(data_axis, tp_axis) if tp_axis else P(data_axis, None)
-    master, opt = jax.jit(jax.shard_map(
+    master, opt = jax.jit(shard_map(
         init, mesh=mesh, in_specs=(pspecs,),
         out_specs=(out_spec,
                    jax.tree_util.tree_map(lambda _: out_spec,
@@ -653,7 +655,7 @@ def build_lm_zero_mesh_step(model: Model, mesh, params_template, tx,
         opt_state=jax.tree_util.tree_map(
             lambda _: zspec, tx.init(jnp.zeros((chunk,), jnp.float32))))
     tok_spec = P(data_axis, seq_axis) if seq_axis else P(data_axis)
-    mapped = jax.shard_map(step, mesh=mesh, in_specs=(st_spec, tok_spec),
+    mapped = shard_map(step, mesh=mesh, in_specs=(st_spec, tok_spec),
                            out_specs=(st_spec, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -717,7 +719,7 @@ def build_zero_optax_step(model: Model, tree: MeshTree, tx,
 
     specs = ZeroTrainState(params=P(), model_state=P(), opt_state=P(axis),
                            sync=P(axis), cm=P(axis), rng=P())
-    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
+    mapped = shard_map(step, mesh=tree.mesh, in_specs=(specs, P(axis),
                                                            P(axis)),
                            out_specs=(specs, P()), check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

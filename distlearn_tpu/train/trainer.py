@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax, random
 from jax.sharding import PartitionSpec as P
 
+from distlearn_tpu.utils.compat import shard_map
+
 from distlearn_tpu.models.core import Model, loss_fn
 from distlearn_tpu.ops import flatten as flatten_lib
 from distlearn_tpu.ops import fused_update
@@ -164,7 +166,7 @@ def build_sgd_step(model: Model, tree: MeshTree, lr: float,
         def step(ts, x, y):
             return _body(ts, x, y, None)
         in_specs = (specs_ts, P(axis), P(axis))
-    mapped = jax.shard_map(step, mesh=tree.mesh,
+    mapped = shard_map(step, mesh=tree.mesh,
                            in_specs=in_specs,
                            out_specs=(specs_ts, P()),
                            check_vma=False)
@@ -263,7 +265,7 @@ def build_sgd_scan_step(model: Model, tree: MeshTree, lr: float,
             ts, losses = lax.scan(scan_body, ts, (xs, ys))
             return ts, losses
         in_specs = (specs_ts, P(None, axis), P(None, axis))
-    mapped = jax.shard_map(steps, mesh=tree.mesh,
+    mapped = shard_map(steps, mesh=tree.mesh,
                            in_specs=in_specs,
                            out_specs=(specs_ts, P()),
                            check_vma=False)
@@ -286,7 +288,7 @@ def build_sync_step(tree: MeshTree, donate: bool = False) -> Callable:
 
     specs_ts = TrainState(params=P(), model_state=P(), sync=P(axis),
                           cm=P(axis), rng=P())
-    mapped = jax.shard_map(step, mesh=tree.mesh, in_specs=(specs_ts,),
+    mapped = shard_map(step, mesh=tree.mesh, in_specs=(specs_ts,),
                            out_specs=specs_ts, check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -304,7 +306,7 @@ def build_eval_step(model: Model, tree: MeshTree) -> Callable:
         cm = metrics_lib.update_confusion(jnp.squeeze(cm, 0), log_probs, y)
         return cm[None], lax.pmean(loss, axis)
 
-    mapped = jax.shard_map(step, mesh=tree.mesh,
+    mapped = shard_map(step, mesh=tree.mesh,
                            in_specs=(P(), P(), P(axis), P(axis), P(axis)),
                            out_specs=(P(axis), P()),
                            check_vma=False)
@@ -385,12 +387,12 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
     spec_ts = EATrainState(params=P(axis), model_state=P(axis), center=P(axis),
                            vel=P(axis), cm=P(axis), rng=P(axis))
     local = jax.jit(
-        jax.shard_map(local_step, mesh=tree.mesh,
+        shard_map(local_step, mesh=tree.mesh,
                       in_specs=(spec_ts, P(axis), P(axis)),
                       out_specs=(spec_ts, P(axis)), check_vma=False),
         donate_argnums=(0,) if donate else ())
     rnd = jax.jit(
-        jax.shard_map(ea_round, mesh=tree.mesh, in_specs=(spec_ts,),
+        shard_map(ea_round, mesh=tree.mesh, in_specs=(spec_ts,),
                       out_specs=spec_ts, check_vma=False),
         donate_argnums=(0,) if donate else ())
     return local, rnd
@@ -462,7 +464,7 @@ def build_ea_cycle(model: Model, tree: MeshTree, lr: float, alpha: float,
 
     spec_ts = EATrainState(params=P(axis), model_state=P(axis), center=P(axis),
                            vel=P(axis), cm=P(axis), rng=P(axis))
-    mapped = jax.shard_map(cycle, mesh=tree.mesh,
+    mapped = shard_map(cycle, mesh=tree.mesh,
                            in_specs=(spec_ts, P(None, axis), P(None, axis)),
                            out_specs=(spec_ts, P(None, axis)),
                            check_vma=False)

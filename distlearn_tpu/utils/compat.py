@@ -1,0 +1,65 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern JAX surface (``jax.shard_map`` with
+``check_vma``, promoted in jax 0.7); CI containers may pin older releases
+where ``shard_map`` still lives in ``jax.experimental.shard_map`` and the
+replication-check knob is called ``check_rep``.  Every internal call site
+imports :func:`shard_map` from here so the whole library runs on either
+API without scattering version branches through the builders.
+
+``install()`` additionally publishes the shim as ``jax.shard_map`` when the
+attribute is missing, so reference-style scripts and tests written against
+the modern spelling keep working on an old pin.  It never overwrites a real
+``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "axis_size", "install"]
+
+# Resolve the underlying implementation ONCE at import: after install()
+# publishes the shim as ``jax.shard_map``, a late getattr would find the
+# shim itself and recurse.
+_NATIVE = getattr(jax, "shard_map", None)
+if _NATIVE is None:
+    from jax.experimental.shard_map import shard_map as _LEGACY
+else:
+    _LEGACY = None
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the modern signature on any supported jax.
+
+    On jax >= 0.7 this is a passthrough; on older releases it adapts to
+    ``jax.experimental.shard_map.shard_map`` (``check_vma`` -> ``check_rep``).
+    Supports the same partial-application style as the real API
+    (``shard_map(mesh=..., ...)`` returning a decorator).
+    """
+    if f is None:
+        return lambda g: shard_map(g, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=check_vma)
+    if _NATIVE is not None:
+        return _NATIVE(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_vma=check_vma)
+    return _LEGACY(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis (``lax.axis_size`` on modern jax).
+
+    Old releases have no ``lax.axis_size``; there ``lax.psum(1, axis)`` of a
+    Python scalar constant-folds to the static axis size, which is what the
+    callers need (they branch on it in Python control flow)."""
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def install() -> None:
+    """Publish the shim as ``jax.shard_map`` if (and only if) absent."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map

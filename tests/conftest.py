@@ -27,6 +27,12 @@ jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
+# Old jax pins (< 0.7) have no ``jax.shard_map``; tests written against the
+# modern spelling go through the compat shim (utils/compat.py).
+from distlearn_tpu.utils import compat  # noqa: E402
+
+compat.install()
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -1,0 +1,160 @@
+"""distlint protocol rules (DL101-DL104): the real tree/ring/AsyncEA
+schedules pass; deliberately broken variants deadlock/desync; the lock
+audit finds cycles and blocking-under-lock in synthetic sources and stays
+quiet on the repo's threaded modules."""
+
+import pytest
+
+from distlearn_tpu.lint.protocol import (async_ea_sync_schedule,
+                                         check_schedules,
+                                         lint_comm_protocols,
+                                         lock_order_audit, recv,
+                                         ring_allreduce_schedule, send,
+                                         tree_allreduce_schedule)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --------------------------------------------------------- real protocols
+
+def test_repo_protocols_are_clean():
+    assert lint_comm_protocols(num_nodes=7) == []
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 7, 8, 15])
+def test_tree_schedule_completes_any_size(n):
+    assert check_schedules(tree_allreduce_schedule(n)) == []
+    # ...even under rendezvous sends: each up-send meets a posted recv.
+    assert check_schedules(tree_allreduce_schedule(n),
+                           buffered_sends=False) == []
+
+
+@pytest.mark.parametrize("base", [2, 3, 4])
+def test_tree_schedule_completes_any_base(base):
+    assert check_schedules(tree_allreduce_schedule(9, base=base)) == []
+
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_ring_schedule_completes_with_buffered_sends(n):
+    assert check_schedules(ring_allreduce_schedule(n)) == []
+
+
+def test_async_ea_handshake_is_clean():
+    assert check_schedules(async_ea_sync_schedule()) == []
+
+
+# --------------------------------------------------------- DL101 deadlock
+
+def test_dl101_ring_under_rendezvous_sends_deadlocks():
+    """Why ring.py owns a _Sender thread: synchronous sends turn the
+    send-first full-duplex step into an all-ranks-blocked cycle."""
+    fs = check_schedules(ring_allreduce_schedule(4), buffered_sends=False,
+                         name="ring-sync")
+    assert _rules(fs) == ["DL101"]
+    assert "cycle" in fs[0].message
+
+
+def test_dl101_mutual_recv_first_deadlocks():
+    sched = {0: [recv(1, "x"), send(1, "y")],
+             1: [recv(0, "y"), send(0, "x")]}
+    fs = check_schedules(sched, name="recv-first")
+    assert _rules(fs) == ["DL101"]
+
+
+def test_dl101_starvation_on_terminated_peer():
+    sched = {0: [send(1, "a")], 1: [recv(0, "a"), recv(0, "b")]}
+    fs = check_schedules(sched, name="starve")
+    assert _rules(fs) == ["DL101"]
+    assert "blocked" in fs[0].message
+
+
+# ----------------------------------------------------------- DL104 desync
+
+def test_dl104_swapped_handshake_questions_desync():
+    fs = check_schedules(
+        async_ea_sync_schedule(client_order=("delta?", "Center?")),
+        name="swapped")
+    assert "DL104" in _rules(fs)
+    assert "disagree on message order" in fs[0].message
+
+
+def test_dl104_tag_skew_detected_point_to_point():
+    sched = {0: [send(1, "hdr"), send(1, "tensor")],
+             1: [recv(0, "tensor"), recv(0, "hdr")]}
+    fs = check_schedules(sched, name="skew")
+    assert _rules(fs) == ["DL104"]
+
+
+# --------------------------------------------------------- DL102 / DL103
+
+_BAD_LOCKS = """
+class A:
+    def f(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+    def g(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+_BAD_BLOCKING = """
+class B:
+    def f(self):
+        with self._lock:
+            self.conn.recv_msg()
+"""
+
+_GOOD_LOCKS = """
+class C:
+    def f(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+    def g(self):
+        with self._a_lock:
+            self.x += 1
+    def h(self):
+        msg = self.conn.recv_msg()   # blocking call OUTSIDE the lock
+        with self._b_lock:
+            self.apply(msg)
+    def spawn(self):
+        with self._a_lock:
+            def worker():
+                # runs on another thread later — the lexically enclosing
+                # lock is NOT held at call time
+                self.conn.recv_msg()
+            return worker
+"""
+
+
+def test_dl102_lock_order_cycle_fires():
+    fs = lock_order_audit([_BAD_LOCKS])
+    assert _rules(fs) == ["DL102"]
+    assert "_a_lock" in fs[0].message and "_b_lock" in fs[0].message
+
+
+def test_dl102_cycle_across_modules_fires():
+    half_a = "class A:\n    def f(self):\n        with self._a_lock:\n            with self._b_lock:\n                pass\n"
+    half_b = "class A:\n    def g(self):\n        with self._b_lock:\n            with self._a_lock:\n                pass\n"
+    assert _rules(lock_order_audit([half_a, half_b])) == ["DL102"]
+    assert lock_order_audit([half_a]) == []
+
+
+def test_dl103_blocking_call_under_lock_fires():
+    fs = lock_order_audit([_BAD_BLOCKING])
+    assert _rules(fs) == ["DL103"]
+    assert "recv_msg" in fs[0].message
+
+
+def test_lock_audit_quiet_on_consistent_order():
+    assert lock_order_audit([_GOOD_LOCKS]) == []
+
+
+def test_lock_audit_quiet_on_repo_threaded_modules():
+    from distlearn_tpu.comm import ring, transport, tree
+    from distlearn_tpu.parallel import async_ea
+    assert lock_order_audit([transport, tree, ring, async_ea]) == []

@@ -170,8 +170,11 @@ def test_sgd_scan_step_matches_per_call_steps():
     ref_leaves = jax.tree_util.tree_leaves(jax.device_get(ts_ref.params))
     got_leaves = jax.tree_util.tree_leaves(jax.device_get(ts.params))
     for a, b in zip(ref_leaves, got_leaves):
+        # atol 1e-5: scan fuses the k steps into one program, so XLA is free
+        # to reassociate reductions differently than the per-call build —
+        # identical math, different summation order, few-ulp f32 drift.
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=1e-5, atol=1e-5)
     # step counters / confusion matrices advance identically
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(ts.sync.my_steps)),

@@ -70,8 +70,22 @@ def test_negative_shape_rejected():
 def test_recv_buffer_mismatch_rejected():
     tx, rx = _pair()
     tx.send_tensor(np.zeros(4, np.float32))
-    with pytest.raises(ValueError, match="mismatch"):
+    with pytest.raises(ProtocolError, match="mismatch"):
         rx.recv_tensor(out=np.zeros(8, np.float32))
+    tx.close(); rx.close()
+
+
+def test_recv_buffer_mismatch_drains_payload():
+    """The mismatch error must leave the connection frame-aligned: the
+    offending payload is consumed, so the NEXT frame parses normally
+    instead of tensor bytes being read as a header."""
+    tx, rx = _pair()
+    tx.send_tensor(np.arange(4, dtype=np.float32))
+    tx.send_tensor(np.arange(6, dtype=np.float64))
+    with pytest.raises(ProtocolError, match="mismatch"):
+        rx.recv_tensor(out=np.zeros((2, 2), np.float32))  # shape skew
+    got = rx.recv_tensor(out=np.zeros(6, np.float64))
+    np.testing.assert_array_equal(got, np.arange(6, dtype=np.float64))
     tx.close(); rx.close()
 
 

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""distlint CLI: lint the repo's step functions and comm protocols.
+
+    python tools/distlint.py --all              # every registered family
+    python tools/distlint.py --family lm        # one family
+    python tools/distlint.py --family sgd --family ea
+    python tools/distlint.py --list             # what's registered
+    python tools/distlint.py --all --disable DL004
+
+Exit code 0 when no error-severity findings survive suppression, 1 when
+findings remain, 2 on usage errors.  Rule catalog: docs/LINT.md.
+"""
+
+import argparse
+import os
+import sys
+
+# The step families need a multi-device mesh; force 8 virtual CPU devices
+# BEFORE jax initialises (tier-1 runs the same way via tests/conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distlearn_tpu.utils import compat  # noqa: E402
+
+compat.install()
+
+from distlearn_tpu.lint.core import RULES, format_findings  # noqa: E402
+from distlearn_tpu.lint import registry  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--all", action="store_true",
+                    help="lint every registered family")
+    ap.add_argument("--family", action="append", default=[],
+                    metavar="NAME", help="lint one family (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered families and rules, then exit")
+    ap.add_argument("--disable", action="append", default=[],
+                    metavar="RULE", help="suppress a rule id (repeatable)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print findings only, no per-unit OK lines")
+    args = ap.parse_args(argv)
+
+    fams = registry.families()
+    if args.list:
+        print("families:")
+        for name, e in fams.items():
+            print(f"  {name:10s} {e.description}")
+        print("rules:")
+        for rid, (title, sev) in RULES.items():
+            print(f"  {rid}  [{sev}] {title}")
+        return 0
+
+    wanted = list(fams) if args.all else args.family
+    if not wanted:
+        ap.print_usage(sys.stderr)
+        print("distlint: pass --all, --family NAME, or --list",
+              file=sys.stderr)
+        return 2
+    unknown = [f for f in wanted if f not in fams]
+    if unknown:
+        print(f"distlint: unknown family {unknown} "
+              f"(have: {', '.join(fams)})", file=sys.stderr)
+        return 2
+    try:
+        suppress = set(args.disable)
+        results = []
+        for fam in wanted:
+            results += registry.run_family(fam, suppress=suppress)
+    except ValueError as e:   # unknown rule id in --disable
+        print(f"distlint: {e}", file=sys.stderr)
+        return 2
+
+    bad = 0
+    for res in results:
+        if res.findings:
+            print(format_findings(res.findings, header=f"{res.name}:"))
+        elif not args.quiet:
+            print(f"{res.name}: OK")
+        bad += 0 if res.ok else 1
+    total = sum(len(r.findings) for r in results)
+    print(f"distlint: {len(results)} unit(s), {total} finding(s)"
+          + (f", {bad} with errors" if bad else ""))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
