@@ -1,6 +1,6 @@
 """distlint: static SPMD/collective and host-communication linting.
 
-Two analysis families share the :class:`~distlearn_tpu.lint.core.Finding`
+Three analysis families share the :class:`~distlearn_tpu.lint.core.Finding`
 vocabulary:
 
 * :mod:`distlearn_tpu.lint.spmd` — abstractly traces a step function to a
@@ -10,6 +10,11 @@ vocabulary:
   schedules of ``comm.tree``/``comm.ring`` and the AsyncEA handshake as
   per-rank message sequences and searches them for wait-for cycles, plus an
   AST audit of lock usage in the threaded paths (DL101–DL104).
+* :mod:`distlearn_tpu.lint.cost` — compiles each step on the deployment
+  mesh and attributes post-fusion collective bytes/ops per mesh axis and
+  peak memory from the HLO (DL201–DL202);
+  :mod:`distlearn_tpu.lint.budget` gates those numbers against committed
+  per-family lockfiles (DL203–DL205).
 
 ``tools/distlint.py`` is the CLI front end; ``lint.registry`` names the
 repo's step-function families so CI can lint all of them in one call.
@@ -17,5 +22,9 @@ repo's step-function families so CI can lint all of them in one call.
 
 from distlearn_tpu.lint.core import Finding, RULES, format_findings
 from distlearn_tpu.lint.spmd import lint_step, lint_jaxpr
+from distlearn_tpu.lint.cost import CollectiveOp, CostReport, analyze_step
+from distlearn_tpu.lint.budget import check_family, load_budget, save_budget
 
-__all__ = ["Finding", "RULES", "format_findings", "lint_step", "lint_jaxpr"]
+__all__ = ["Finding", "RULES", "format_findings", "lint_step", "lint_jaxpr",
+           "CollectiveOp", "CostReport", "analyze_step",
+           "check_family", "load_budget", "save_budget"]

@@ -2,7 +2,8 @@
 
 A :class:`Finding` is one rule violation at one program point.  Rules are
 identified by stable IDs (``DL0xx`` for jaxpr-level SPMD rules, ``DL1xx``
-for host-communication rules) so they can be suppressed individually —
+for host-communication rules, ``DL2xx`` for compiled-HLO cost/budget
+rules) so they can be suppressed individually —
 per call (``suppress={"DL004"}``), per registry entry, or from the CLI
 (``--disable DL004``).  docs/LINT.md is the rule catalog.
 """
@@ -24,6 +25,16 @@ RULES = {
               "dtype", "error"),
     "DL005": ("donated input buffer has no shape/dtype-compatible output "
               "to alias (donation is wasted or unsafe)", "error"),
+    "DL201": ("GSPMD inserted an implicit all-gather with a large operand "
+              "(sharding was lost on a hot path)", "error"),
+    "DL202": ("parameter-sized buffer materialized replicated despite a "
+              "sharded in-spec", "error"),
+    "DL203": ("collective traffic exceeds the family's committed budget "
+              "lockfile", "error"),
+    "DL204": ("compiled peak memory regressed vs. the family's budget "
+              "lockfile", "error"),
+    "DL205": ("post-fusion collective op count regressed vs. the family's "
+              "budget lockfile", "error"),
     "DL101": ("host send/recv schedule admits a wait-for cycle "
               "(static deadlock)", "error"),
     "DL102": ("lock acquisition order forms a cycle across threads",

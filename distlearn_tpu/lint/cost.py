@@ -1,0 +1,406 @@
+"""Static collective-traffic & memory cost model (rules DL201, DL202).
+
+Where :mod:`distlearn_tpu.lint.spmd` analyzes the program the *author*
+wrote (the jaxpr), this module analyzes the program the *compiler* built:
+each step function is lowered and compiled on the deployment mesh and the
+post-fusion HLO module is walked to attribute
+
+* **bytes per collective kind per mesh axis** — every ``all-reduce``,
+  ``all-gather``, ``reduce-scatter``, ``collective-permute`` and
+  ``all-to-all`` op is parsed out of the module text with its payload
+  shape and replica groups, and the groups are mapped back to the mesh
+  axes they span (explicit ``{{0,4},{1,5}}`` lists, iota-form
+  ``[2,4]<=[8]`` lists, and permute ``source_target_pairs`` all
+  supported);
+* **post-fusion collective op counts** — what fusion actually left in the
+  module, which is what the wire sees (``ops/fused_update.py`` degrading
+  to per-tensor reduces shows up here long before a profile would);
+* **compiled peak/temp memory** via
+  :func:`distlearn_tpu.utils.compat.compiled_memory_stats`.
+
+The numbers are *per device per step*: the module XLA emits under SPMD
+partitioning is the one program every device runs, with local (sharded)
+shapes, so a payload byte count is what one device moves through one
+step.  Two rules fire directly from the model:
+
+* **DL201** — the compiled module contains more *large* all-gathers
+  (payload >= :data:`GATHER_BYTES_THRESHOLD`) than the jaxpr requested
+  explicitly: GSPMD sharding propagation lost a sharding on a hot path
+  and is rematerializing a full buffer every step.
+* **DL202** — the caller declared a sharded in-spec for a large argument
+  but the compiled executable materializes that parameter fully
+  replicated (>= :data:`REPLICATED_BYTES_THRESHOLD`).
+
+Budget regression rules DL203-DL205 compare a :class:`CostReport` against
+the committed per-family lockfiles — see :mod:`distlearn_tpu.lint.budget`.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from distlearn_tpu.lint.core import Finding
+from distlearn_tpu.utils import compat
+
+__all__ = ["CollectiveOp", "CostReport", "analyze_step",
+           "parse_collectives", "GATHER_BYTES_THRESHOLD",
+           "REPLICATED_BYTES_THRESHOLD", "COLLECTIVE_KINDS"]
+
+#: HLO opcodes the model attributes traffic to.
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+#: DL201 fires only for implicit all-gathers at least this large: tiny
+#: gathers (scalars, loop counters, eval metrics) are GSPMD doing its job.
+GATHER_BYTES_THRESHOLD = 1 << 20
+
+#: DL202 fires only for replicated parameters at least this large.
+REPLICATED_BYTES_THRESHOLD = 1 << 20
+
+# f8 variants intentionally coarse; HLO spells dtypes like f32, bf16, s64.
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+_DTYPE_BYTES.update({f"f8{suffix}": 1 for suffix in
+                     ("e4m3fn", "e5m2", "e4m3b11fnuz", "e4m3fnuz", "e5m2fnuz")})
+
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]+(?:[a-z0-9]*)?|pred)\[([0-9,]*)\]")
+# `%name = <shape> <kind>(`: shape is a bare token or a (tuple).  Operand
+# references (`%all-gather.3`) never match — they are not preceded by
+# `= <shape>` and not followed by `(`.
+_OP_RE = re.compile(
+    r"=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\(")
+_GROUPS_RE = re.compile(r"replica_groups=(\{\{[0-9,{} ]*\}\}|\{\}|"
+                        r"\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([0-9,{} ]*)\}")
+
+
+def _shape_bytes(shape_token: str) -> int:
+    """Byte size of one HLO shape token (``f32[4,8]{1,0}`` or a tuple)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_token):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue  # token dtype (opaque, s32[]-like already matched)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def _parse_groups(attr: str) -> list[tuple[int, ...]]:
+    """Parse a ``replica_groups=`` payload into device-id groups."""
+    if attr.startswith("{"):
+        return [tuple(int(x) for x in grp.split(",") if x.strip())
+                for grp in re.findall(r"\{([0-9, ]+)\}", attr)]
+    # iota form: [G,S]<=[dims](T(perm))? — arange over the flattened device
+    # space, reshaped to `dims`, transposed by `perm`, regrouped as G rows.
+    m = re.match(r"\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?", attr)
+    if not m:
+        return []
+    out_dims = [int(x) for x in m.group(1).split(",")]
+    iota_dims = [int(x) for x in m.group(2).split(",")]
+    ids = np.arange(math.prod(iota_dims)).reshape(iota_dims)
+    if m.group(3):
+        ids = ids.transpose([int(x) for x in m.group(3).split(",")])
+    return [tuple(int(x) for x in row)
+            for row in ids.reshape(out_dims[0], -1)]
+
+
+def _mesh_device_ids(mesh) -> tuple[np.ndarray, tuple[str, ...]] | None:
+    devices = getattr(mesh, "devices", None)
+    names = getattr(mesh, "axis_names", None)
+    if devices is None or names is None:
+        return None
+    ids = np.vectorize(lambda d: getattr(d, "id", -1))(np.asarray(devices))
+    return ids, tuple(str(a) for a in names)
+
+
+def _axes_for_groups(mesh, groups: Sequence[tuple[int, ...]]
+                     ) -> tuple[str, ...]:
+    """Mesh axes a replica-group list spans (``("?",)`` when unknown).
+
+    A collective grouped along axis subset ``S`` partitions the devices
+    into one group per coordinate of the *other* axes; we test every
+    non-empty subset (meshes here have <= 4 axes) against the parsed
+    groups.  Size-1 groups are the degenerate no-communication case and
+    return ``()``.
+    """
+    if not groups:
+        return ("?",)
+    if all(len(g) <= 1 for g in groups):
+        return ()
+    info = _mesh_device_ids(mesh)
+    if info is None:
+        return ("?",)
+    ids, names = info
+    want = {frozenset(g) for g in groups}
+    for mask in range(1, 1 << len(names)):
+        subset = [i for i in range(len(names)) if mask & (1 << i)]
+        rest = [i for i in range(len(names)) if i not in subset]
+        grouped = ids.transpose(rest + subset).reshape(
+            -1, math.prod(ids.shape[i] for i in subset))
+        if {frozenset(int(x) for x in row) for row in grouped} == want:
+            return tuple(names[i] for i in subset)
+    return ("?",)
+
+
+def _axes_for_pairs(mesh, pairs: Sequence[tuple[int, int]]
+                    ) -> tuple[str, ...]:
+    """Mesh axes a permute's source->target pairs move along."""
+    info = _mesh_device_ids(mesh)
+    if info is None or not pairs:
+        return ("?",)
+    ids, names = info
+    where = {int(v): np.unravel_index(i, ids.shape)
+             for i, v in enumerate(ids.ravel())}
+    axes: set[str] = set()
+    for src, dst in pairs:
+        if src not in where or dst not in where:
+            return ("?",)
+        for dim, (a, b) in enumerate(zip(where[src], where[dst])):
+            if a != b:
+                axes.add(names[dim])
+    return tuple(a for a in names if a in axes)
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One post-fusion collective in the compiled module."""
+
+    kind: str            # one of COLLECTIVE_KINDS
+    bytes: int           # payload bytes (local/per-device shape)
+    axes: tuple          # mesh axes the op communicates over
+    shape: str           # the HLO result shape token, for messages
+
+    @property
+    def axis_key(self) -> str:
+        return f"{self.kind}@{','.join(self.axes) or '-'}"
+
+
+@dataclass
+class CostReport:
+    """Static cost of one compiled step function.
+
+    ``bytes_by_kind`` / ``ops_by_kind`` aggregate over mesh axes;
+    ``bytes_by_axis`` keeps the per-axis split (keys like
+    ``"all-reduce@data"``).  ``memory`` is the
+    :func:`~distlearn_tpu.utils.compat.compiled_memory_stats` dict (or
+    None where the backend reports nothing); ``flops`` comes from the
+    compiler's own cost analysis when available.
+    """
+
+    name: str
+    collectives: list[CollectiveOp] = field(default_factory=list)
+    memory: dict | None = None
+    flops: float | None = None
+
+    @property
+    def bytes_by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.collectives:
+            out[op.kind] = out.get(op.kind, 0) + op.bytes
+        return out
+
+    @property
+    def ops_by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.collectives:
+            out[op.kind] = out.get(op.kind, 0) + 1
+        return out
+
+    @property
+    def bytes_by_axis(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.collectives:
+            out[op.axis_key] = out.get(op.axis_key, 0) + op.bytes
+        return out
+
+    @property
+    def ops_by_axis(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.collectives:
+            out[op.axis_key] = out.get(op.axis_key, 0) + 1
+        return out
+
+    @property
+    def peak_bytes(self) -> int | None:
+        return self.memory.get("peak") if self.memory else None
+
+    def to_json(self) -> dict:
+        return {
+            "collective_bytes": self.bytes_by_kind,
+            "collective_ops": self.ops_by_kind,
+            "bytes_by_axis": self.bytes_by_axis,
+            "peak_bytes": self.peak_bytes,
+            "temp_bytes": self.memory.get("temp") if self.memory else None,
+            "flops": self.flops,
+        }
+
+
+def parse_collectives(hlo_text: str, mesh=None) -> list[CollectiveOp]:
+    """Extract every collective op from compiled HLO module text.
+
+    Async pairs are counted once (the ``-start`` op carries the shape and
+    groups; ``-done`` never matches).  ``mesh`` enables axis attribution;
+    without it every op reports axes ``("?",)``.
+    """
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        nbytes = _shape_bytes(m.group("shape"))
+        if kind == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            pairs = [tuple(int(x) for x in p.split(","))
+                     for p in re.findall(r"\{([0-9, ]+)\}",
+                                         pm.group(1))] if pm else []
+            axes = _axes_for_pairs(mesh, pairs) if mesh is not None else ("?",)
+        else:
+            gm = _GROUPS_RE.search(line)
+            groups = _parse_groups(gm.group(1)) if gm else []
+            axes = (_axes_for_groups(mesh, groups)
+                    if mesh is not None else ("?",))
+        ops.append(CollectiveOp(kind=kind, bytes=nbytes, axes=axes,
+                                shape=m.group("shape")))
+    return ops
+
+
+def _count_explicit_gathers(fn, args) -> int:
+    """Author-requested all-gathers: ``all_gather``/``pgather`` equations
+    anywhere in the traced jaxpr (the baseline DL201 subtracts)."""
+    import jax
+    from jax import core as jcore
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception:
+        return 0
+
+    def jaxprs_in(v):
+        if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+            yield v.jaxpr if isinstance(v, jcore.ClosedJaxpr) else v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                yield from jaxprs_in(item)
+
+    count = 0
+    stack = [closed.jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            if eqn.primitive.name in ("all_gather", "pgather"):
+                count += 1
+            for v in eqn.params.values():
+                stack.extend(jaxprs_in(v))
+    return count
+
+
+def _spec_is_sharded(spec) -> bool:
+    """True when a PartitionSpec/NamedSharding names at least one axis."""
+    inner = getattr(spec, "spec", spec)       # NamedSharding -> its spec
+    try:
+        parts = tuple(inner)
+    except TypeError:
+        return False
+    for p in parts:
+        if p is None:
+            continue
+        if isinstance(p, (tuple, list)):
+            if any(p):
+                return True
+        else:
+            return True
+    return False
+
+
+def _check_replicated_params(lowered, compiled, args, in_specs,
+                             name: str) -> list[Finding]:
+    """DL202: declared-sharded large arguments compiled fully replicated."""
+    import jax
+    try:
+        actual = compiled.input_shardings[0]
+    except Exception:
+        return []
+    arg_leaves = jax.tree_util.tree_leaves(args)
+    spec_leaves = jax.tree_util.tree_leaves(
+        in_specs, is_leaf=lambda x: x is None or _is_spec(x))
+    if len(arg_leaves) != len(spec_leaves) or \
+            len(arg_leaves) != len(actual):
+        return []
+    findings = []
+    for leaf, spec, sharding in zip(arg_leaves, spec_leaves, actual):
+        if spec is None or not _spec_is_sharded(spec):
+            continue
+        size = getattr(leaf, "size", 0) * getattr(
+            np.dtype(getattr(leaf, "dtype", "f4")), "itemsize", 4)
+        if size < REPLICATED_BYTES_THRESHOLD:
+            continue
+        if getattr(sharding, "is_fully_replicated", False):
+            findings.append(Finding(
+                "DL202",
+                f"argument declared sharded as {spec} "
+                f"({size} bytes) compiles to a fully replicated "
+                "parameter; the sharding was dropped between the in-spec "
+                "and the executable (check with_sharding_constraint / "
+                "jit in_shardings wiring)",
+                where=name))
+    return findings
+
+
+def _is_spec(x) -> bool:
+    from jax.sharding import NamedSharding, PartitionSpec
+    return isinstance(x, (NamedSharding, PartitionSpec))
+
+
+def analyze_step(fn, args: Sequence, *, mesh=None, name: str = "step",
+                 in_specs=None,
+                 gather_threshold: int = GATHER_BYTES_THRESHOLD
+                 ) -> tuple[CostReport, list[Finding]]:
+    """Compile ``fn(*args)`` and build its :class:`CostReport`.
+
+    Returns ``(report, findings)`` where findings are the compile-level
+    rules (DL201 implicit all-gather, DL202 replicated parameter); the
+    lockfile rules DL203-DL205 are applied by
+    :func:`distlearn_tpu.lint.budget.check_family` over a whole family's
+    reports.  ``in_specs`` (optional pytree of
+    PartitionSpec/NamedSharding leaves matching ``args``) enables DL202.
+    """
+    lowered, compiled = compat.lower_compiled(fn, args)
+    report = CostReport(
+        name=name,
+        collectives=parse_collectives(compiled.as_text(), mesh),
+        memory=compat.compiled_memory_stats(compiled),
+        flops=compat.compiled_cost_analysis(compiled).get("flops"),
+    )
+    findings = []
+    large = [op for op in report.collectives
+             if op.kind == "all-gather" and op.bytes >= gather_threshold]
+    explicit = _count_explicit_gathers(fn, args) if large else 0
+    if len(large) > explicit:
+        worst = max(large, key=lambda op: op.bytes)
+        findings.append(Finding(
+            "DL201",
+            f"compiled module contains {len(large)} all-gather op(s) of "
+            f">= {gather_threshold} bytes but the jaxpr requests only "
+            f"{explicit}; GSPMD inserted a replication gather (largest: "
+            f"{worst.shape} over axes {list(worst.axes)}, {worst.bytes} "
+            "bytes/step) — re-shard the producer or add a "
+            "with_sharding_constraint",
+            where=name))
+    if in_specs is not None:
+        findings += _check_replicated_params(lowered, compiled, args,
+                                             in_specs, name)
+    return report, findings

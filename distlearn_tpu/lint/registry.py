@@ -6,27 +6,48 @@ registry is what ``tools/distlint.py --family sgd`` and the tier-1 gate
 test iterate over, so adding a builder here is how a new train step opts
 into CI linting.
 
+Builders return :class:`Unit` objects.  A unit that carries its jitted
+callable (``fn``/``args``/``mesh``) additionally goes through the static
+cost model (:mod:`distlearn_tpu.lint.cost`): the step is compiled on the
+mesh, its post-fusion collective traffic and peak memory are extracted,
+and the result is checked against the family's committed budget lockfile
+(:mod:`distlearn_tpu.lint.budget`, rules DL201-DL205).  Host-protocol
+units (no compilable step) carry ``fn=None`` and skip the cost pass.
+
 Callers must provide >= :data:`MIN_DEVICES` devices (the test conftest and
 the CLI both force 8 virtual CPU devices before jax initialises).
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Callable, Sequence
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
 
 from distlearn_tpu.lint.core import Finding, LintResult, filter_suppressed
 
-__all__ = ["Entry", "MIN_DEVICES", "families", "run_family", "run_all"]
+__all__ = ["Entry", "Unit", "MIN_DEVICES", "families", "run_family",
+           "run_family_costed", "run_all"]
 
 MIN_DEVICES = 8
+
+
+@dataclass
+class Unit:
+    """One lintable unit: findings plus (optionally) the compilable step."""
+
+    name: str
+    findings: list[Finding] = field(default_factory=list)
+    fn: Callable | None = None
+    args: tuple = ()
+    mesh: Any = None
+    in_specs: Any = None     # pytree of PartitionSpecs matching args (DL202)
 
 
 @dataclass(frozen=True)
 class Entry:
     name: str
     description: str
-    run: Callable[[], list[tuple[str, list[Finding]]]]
+    run: Callable[[], list[Unit]]
 
 
 def _mnist_setup(num_nodes=2):
@@ -39,8 +60,15 @@ def _mnist_setup(num_nodes=2):
     return jax, random, model, tree
 
 
-def _sgd_family():
+def _lint_units(units, mesh) -> list[Unit]:
+    """Lint ``(name, fn, args)`` triples into step-carrying Units."""
     from distlearn_tpu.lint.spmd import lint_step
+    return [Unit(n, lint_step(f, a, mesh=mesh, name=n),
+                 fn=f, args=tuple(a), mesh=mesh)
+            for n, f, a in units]
+
+
+def _sgd_family():
     jax, random, model, tree = _mnist_setup()
     from distlearn_tpu.train import (build_eval_step, build_sgd_scan_step,
                                      build_sgd_step, build_sync_step,
@@ -58,11 +86,10 @@ def _sgd_family():
         ("eval_step", build_eval_step(model, tree),
          (ts.params, ts.model_state, ts.cm, x, y)),
     ]
-    return [(n, lint_step(f, a, mesh=tree.mesh, name=n)) for n, f, a in units]
+    return _lint_units(units, tree.mesh)
 
 
 def _ea_family():
-    from distlearn_tpu.lint.spmd import lint_step
     jax, random, model, tree = _mnist_setup()
     from distlearn_tpu.train import (build_ea_cycle, build_ea_steps,
                                      init_ea_state)
@@ -78,14 +105,13 @@ def _ea_family():
         ("ea_round", ea_round, (ts,)),
         ("ea_cycle", cycle, (ts, xs, ys)),
     ]
-    return [(n, lint_step(f, a, mesh=tree.mesh, name=n)) for n, f, a in units]
+    return _lint_units(units, tree.mesh)
 
 
 def _lm_family():
     import numpy as np
     import jax
     from jax.sharding import Mesh
-    from distlearn_tpu.lint.spmd import lint_step
     from distlearn_tpu.models.transformer import transformer_lm
     from distlearn_tpu.train import build_lm_step
     dp, sp, tp = 2, 2, 2
@@ -96,15 +122,13 @@ def _lm_family():
     params, _ = model.init(jax.random.PRNGKey(0))
     step = build_lm_step(model, mesh, params, lr=0.1)
     tokens = jax.ShapeDtypeStruct((2 * dp, L), "int32")
-    return [("lm_step",
-             lint_step(step, (params, tokens), mesh=mesh, name="lm_step"))]
+    return _lint_units([("lm_step", step, (params, tokens))], mesh)
 
 
 def _lm_mixed_family():
     import numpy as np
     import jax
     from jax.sharding import Mesh
-    from distlearn_tpu.lint.spmd import lint_step
     from distlearn_tpu.models.transformer import transformer_lm
     from distlearn_tpu.train import build_lm_mixed_step, init_lm_mixed_state
     dp, sp, tp = 2, 2, 2
@@ -118,15 +142,13 @@ def _lm_mixed_family():
     # DL004-clean scheme docs/PERF.md motivates.
     step = build_lm_mixed_step(model, mesh, params, lr=0.1)
     tokens = jax.ShapeDtypeStruct((2 * dp, L), "int32")
-    return [("lm_mixed_step",
-             lint_step(step, (st, tokens), mesh=mesh, name="lm_mixed_step"))]
+    return _lint_units([("lm_mixed_step", step, (st, tokens))], mesh)
 
 
 def _pp_family():
     import numpy as np
     import jax
     from jax.sharding import Mesh
-    from distlearn_tpu.lint.spmd import lint_step
     from distlearn_tpu.models.transformer import transformer_lm
     from distlearn_tpu.train import (build_lm_pp_1f1b_step, build_lm_pp_step,
                                      stack_blocks)
@@ -138,17 +160,17 @@ def _pp_family():
     tokens = jax.ShapeDtypeStruct((8, 16), "int32")
     units = [
         ("lm_pp_step", build_lm_pp_step(mesh, shared, stacked, lr=0.1,
-                                        num_microbatches=2)),
+                                        num_microbatches=2),
+         (shared, stacked, tokens)),
         ("lm_pp_1f1b_step", build_lm_pp_1f1b_step(mesh, shared, stacked,
                                                   lr=0.1,
-                                                  num_microbatches=2)),
+                                                  num_microbatches=2),
+         (shared, stacked, tokens)),
     ]
-    return [(n, lint_step(f, (shared, stacked, tokens), mesh=mesh, name=n))
-            for n, f in units]
+    return _lint_units(units, mesh)
 
 
 def _optax_family():
-    from distlearn_tpu.lint.spmd import lint_step
     jax, random, model, tree = _mnist_setup()
     import optax
     from distlearn_tpu.train import (build_optax_step,
@@ -166,7 +188,89 @@ def _optax_family():
         ("optax_step", step, (ts, x, y)),
         ("zero_optax_step", zstep, (zts, x, y)),
     ]
-    return [(n, lint_step(f, a, mesh=tree.mesh, name=n)) for n, f, a in units]
+    return _lint_units(units, tree.mesh)
+
+
+def _ep_family():
+    """MoE expert-parallel step: all-to-all dispatch/return over the
+    ``expert`` axis plus a psum'd replicated-router update — the
+    registry's only all-to-all traffic, so the cost lockfile pins it."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from distlearn_tpu.parallel.ep import moe_ffn
+    from distlearn_tpu.utils.compat import shard_map
+    E, N, D = MIN_DEVICES, 16, 32
+    mesh = Mesh(np.array(jax.devices()[:E]), ("expert",))
+
+    def expert(p, h):
+        return jnp.tanh(h @ p)
+
+    def fwd(params, x_all):
+        ep_w = jnp.squeeze(params["experts"], 0)   # this device's expert
+        x = jnp.squeeze(x_all, 0)
+        y = moe_ffn(expert, ep_w, params["router"], x, axis_name="expert")
+        return y[None]
+
+    def loss(params, x_all):
+        return jnp.mean(fwd(params, x_all) ** 2)
+
+    def train(params, x_all):
+        l, g = jax.value_and_grad(loss)(params, x_all)
+        # expert weights are per-device (owned), the router is replicated:
+        # its grad must be reduced across the expert axis before the update
+        g_router = lax.psum(g["router"], "expert")
+        new = {"experts": params["experts"] - 0.1 * g["experts"],
+               "router": params["router"] - 0.1 * g_router}
+        return new, lax.pmean(l, "expert")
+
+    specs = ({"experts": P("expert"), "router": P()}, P("expert"))
+    mk = lambda f, out: jax.jit(shard_map(
+        f, mesh=mesh, in_specs=specs, out_specs=out, check_vma=False))
+    params = {"experts": jax.ShapeDtypeStruct((E, D, D), "float32"),
+              "router": jax.ShapeDtypeStruct((D, E), "float32")}
+    x_all = jax.ShapeDtypeStruct((E, N, D), "float32")
+    units = [
+        ("moe_fwd", mk(fwd, P("expert")), (params, x_all)),
+        ("moe_train_step",
+         mk(train, ({"experts": P("expert"), "router": P()}, P())),
+         (params, x_all)),
+    ]
+    return _lint_units(units, mesh)
+
+
+def _seq_family():
+    """Sequence-parallel attention steps: ring (collective-permute per
+    hop), the zigzag causal schedule, and the Ulysses all-to-all head
+    swap — three distinct traffic shapes over one ``seq`` axis."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from distlearn_tpu.parallel.sequence import (alltoall_attention,
+                                                 ring_attention)
+    from distlearn_tpu.utils.compat import shard_map
+    n = MIN_DEVICES
+    mesh = Mesh(np.array(jax.devices()[:n]), ("seq",))
+    B, L, H, D = 2, 16 * n, n, 16     # H divisible by n (ulysses), L/n even
+    qkv = tuple(jax.ShapeDtypeStruct((B, L, H, D), "float32")
+                for _ in range(3))
+
+    def mk(f):
+        return jax.jit(shard_map(f, mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+                                 out_specs=P(None, "seq"), check_vma=False))
+    units = [
+        ("ring_attention",
+         mk(lambda q, k, v: ring_attention(q, k, v, "seq", causal=True)),
+         qkv),
+        ("zigzag_ring_attention",
+         mk(lambda q, k, v: ring_attention(q, k, v, "seq", causal=True,
+                                           layout="zigzag")), qkv),
+        ("ulysses_attention",
+         mk(lambda q, k, v: alltoall_attention(q, k, v, "seq")), qkv),
+    ]
+    return _lint_units(units, mesh)
 
 
 def _protocol_family():
@@ -175,18 +279,18 @@ def _protocol_family():
                                              lint_comm_protocols,
                                              ring_allreduce_schedule,
                                              tree_allreduce_schedule)
-    units = [("comm_protocols", lint_comm_protocols(num_nodes=7))]
+    units = [Unit("comm_protocols", lint_comm_protocols(num_nodes=7))]
     # Cover the schedule space beyond the default size as well.
     for n in (2, 3, 5, 8):
-        units.append((f"tree[{n}]",
-                      check_schedules(tree_allreduce_schedule(n),
-                                      name=f"tree[{n}]")))
-        units.append((f"ring[{n}]",
-                      check_schedules(ring_allreduce_schedule(n),
-                                      name=f"ring[{n}]")))
-    units.append(("async_ea[L=5]",
-                  check_schedules(async_ea_sync_schedule(num_leaves=5),
-                                  name="async_ea[L=5]")))
+        units.append(Unit(f"tree[{n}]",
+                          check_schedules(tree_allreduce_schedule(n),
+                                          name=f"tree[{n}]")))
+        units.append(Unit(f"ring[{n}]",
+                          check_schedules(ring_allreduce_schedule(n),
+                                          name=f"ring[{n}]")))
+    units.append(Unit("async_ea[L=5]",
+                      check_schedules(async_ea_sync_schedule(num_leaves=5),
+                                      name="async_ea[L=5]")))
     return units
 
 
@@ -202,6 +306,10 @@ _FAMILIES = {
                 _pp_family),
     "optax": Entry("optax", "optax-backed data-parallel + ZeRO-sharded steps",
                    _optax_family),
+    "ep": Entry("ep", "MoE expert-parallel steps (all-to-all dispatch)",
+                _ep_family),
+    "seq": Entry("seq", "sequence-parallel attention (ring/zigzag/ulysses)",
+                 _seq_family),
     "protocol": Entry("protocol",
                       "host comm schedules (tree/ring/AsyncEA) + lock audit",
                       _protocol_family),
@@ -212,9 +320,7 @@ def families() -> dict[str, Entry]:
     return dict(_FAMILIES)
 
 
-def run_family(name: str, *, suppress: Sequence[str] = ()) -> list[LintResult]:
-    """Lint one family; returns one :class:`LintResult` per step function."""
-    entry = _FAMILIES[name]
+def _require_devices():
     import jax
     n = len(jax.devices())
     if n < MIN_DEVICES:
@@ -223,12 +329,52 @@ def run_family(name: str, *, suppress: Sequence[str] = ()) -> list[LintResult]:
             f"families (got {n}); set "
             "XLA_FLAGS=--xla_force_host_platform_device_count=8 before "
             "importing jax (tools/distlint.py does this)")
-    return [LintResult(f"{name}:{unit}", filter_suppressed(fs, suppress))
-            for unit, fs in entry.run()]
 
 
-def run_all(*, suppress: Sequence[str] = ()) -> list[LintResult]:
+def run_family_costed(name: str, *, suppress: Sequence[str] = (),
+                      cost: bool = True, budget_dir: str | None = None):
+    """Lint one family AND run its steps through the static cost model.
+
+    Returns ``(results, reports)``: one :class:`LintResult` per unit (plus
+    a synthetic ``<family>:budget`` result when lockfile comparison finds
+    anything), and a ``{unit_name: CostReport}`` dict for the CLI's cost
+    tables / ``--update-budgets``.
+    """
+    entry = _FAMILIES[name]
+    _require_devices()
+    units = entry.run()
+    reports = {}
+    results = []
+    for u in units:
+        findings = list(u.findings)
+        if cost and u.fn is not None:
+            from distlearn_tpu.lint import cost as cost_mod
+            report, cost_findings = cost_mod.analyze_step(
+                u.fn, u.args, mesh=u.mesh, name=f"{name}:{u.name}",
+                in_specs=u.in_specs)
+            reports[u.name] = report
+            findings += cost_findings
+        results.append(LintResult(f"{name}:{u.name}",
+                                  filter_suppressed(findings, suppress)))
+    if cost:
+        from distlearn_tpu.lint import budget as budget_mod
+        bfindings = filter_suppressed(
+            budget_mod.check_family(name, reports, budget_dir=budget_dir),
+            suppress)
+        if bfindings:
+            results.append(LintResult(f"{name}:budget", bfindings))
+    return results, reports
+
+
+def run_family(name: str, *, suppress: Sequence[str] = (),
+               cost: bool = True) -> list[LintResult]:
+    """Lint one family; returns one :class:`LintResult` per step function."""
+    return run_family_costed(name, suppress=suppress, cost=cost)[0]
+
+
+def run_all(*, suppress: Sequence[str] = (),
+            cost: bool = True) -> list[LintResult]:
     out = []
     for name in _FAMILIES:
-        out += run_family(name, suppress=suppress)
+        out += run_family(name, suppress=suppress, cost=cost)
     return out

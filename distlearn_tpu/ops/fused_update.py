@@ -20,7 +20,6 @@ the CPU mesh exercise the identical code path.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Any
 
 import jax
@@ -28,6 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from distlearn_tpu.ops import flatten as flatten_lib
+from distlearn_tpu.utils import flags
 from distlearn_tpu.ops.flatten import LANE, SUBLANE
 
 PyTree = Any
@@ -41,9 +41,9 @@ def fused_enabled(override: bool | None = None) -> bool:
     correct but slower than XLA's own fusion, so it is opt-in there)."""
     if override is not None:
         return bool(override)
-    env = os.environ.get("DISTLEARN_TPU_FUSED")
+    env = flags.env_truthy("DISTLEARN_TPU_FUSED")
     if env is not None:
-        return env.lower() not in ("0", "false", "off", "")
+        return env
     return jax.default_backend() == "tpu"
 
 _BLOCK_ROWS = 256  # rows of 128 lanes per grid step (128 KiB f32 per ref)

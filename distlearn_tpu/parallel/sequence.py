@@ -376,9 +376,8 @@ def _flash_enabled(override: bool | None) -> bool:
     it on for long-context configs)."""
     if override is not None:
         return bool(override)
-    import os
-    env = os.environ.get("DISTLEARN_TPU_FLASH")
-    return env is not None and env.lower() not in ("0", "false", "off", "")
+    from distlearn_tpu.utils.flags import env_truthy
+    return bool(env_truthy("DISTLEARN_TPU_FLASH"))
 
 
 def local_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "axis_size", "install"]
+__all__ = ["shard_map", "axis_size", "install", "lower_compiled",
+           "compiled_cost_analysis", "compiled_memory_stats"]
 
 # Resolve the underlying implementation ONCE at import: after install()
 # publishes the shim as ``jax.shard_map``, a late getattr would find the
@@ -63,3 +64,69 @@ def install() -> None:
     """Publish the shim as ``jax.shard_map`` if (and only if) absent."""
     if not hasattr(jax, "shard_map"):
         jax.shard_map = shard_map
+
+
+# --- compiled-executable introspection (lint/cost.py) -----------------------
+#
+# The Compiled surface moved around across jax releases: ``cost_analysis``
+# returns a list of dicts on some jaxlib versions and a bare dict on others,
+# ``memory_analysis`` may be missing entirely on exotic backends, and old
+# wrappers spell ``lower`` differently for non-jit callables.  The cost
+# analyzer goes through these three helpers so it never touches the raw
+# surface.
+
+def lower_compiled(fn, args):
+    """Lower ``fn(*args)`` and compile it; wraps bare callables in jit.
+
+    Returns ``(lowered, compiled)``.  ``args`` may be abstract
+    (:class:`jax.ShapeDtypeStruct`) — nothing is executed.
+    """
+    lower = getattr(fn, "lower", None)
+    if lower is None:
+        lower = jax.jit(fn).lower
+    lowered = lower(*args)
+    return lowered, lowered.compile()
+
+
+def compiled_cost_analysis(compiled) -> dict:
+    """``compiled.cost_analysis()`` normalized to one flat dict.
+
+    jaxlib <= 0.4.x returns a single-element list of dicts (one per
+    partition, all identical under SPMD); newer releases return the dict
+    directly.  Returns ``{}`` when the backend offers no analysis."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
+
+
+def compiled_memory_stats(compiled) -> dict | None:
+    """Byte-level memory stats of a compiled executable, or None.
+
+    Normalizes ``compiled.memory_analysis()`` (a ``CompiledMemoryStats``
+    object on XLA backends) to a plain dict with ``argument``, ``output``,
+    ``temp``, ``alias``, ``generated_code`` byte counts plus a derived
+    ``peak`` (arguments + outputs + temporaries, minus donated aliases —
+    the live-at-once footprint the budget lockfiles gate)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    get = lambda attr: int(getattr(ma, attr + "_size_in_bytes", 0) or 0)
+    stats = {
+        "argument": get("argument"),
+        "output": get("output"),
+        "temp": get("temp"),
+        "alias": get("alias"),
+        "generated_code": get("generated_code"),
+    }
+    if not any(stats.values()):
+        return None
+    stats["peak"] = max(0, stats["argument"] + stats["output"]
+                        + stats["temp"] - stats["alias"])
+    return stats

@@ -10,7 +10,26 @@ argparse keeping the same flag names, with ``--tpu`` replacing ``--cuda``
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Any, Sequence
+
+#: Spellings that turn a DISTLEARN_TPU_* switch off; everything else that
+#: is set (including "1", "true", "yes", even "maybe") counts as on.
+_FALSY = ("0", "false", "off", "")
+
+
+def env_truthy(name: str) -> bool | None:
+    """Tri-state truthiness of an env switch: ``None`` when unset (caller
+    applies its own default), else the shared 0/false/off/empty rule.
+
+    The ONE parser for the framework's feature toggles
+    (``DISTLEARN_TPU_FUSED``, ``DISTLEARN_TPU_FLASH``, ...) — the fused
+    kernels and the attention dispatch previously each had a copy, which
+    is exactly how the accepted spellings drift apart."""
+    value = os.environ.get(name)
+    if value is None:
+        return None
+    return value.lower() not in _FALSY
 
 
 def _flag(parser: argparse.ArgumentParser, name: str, default, help_: str):

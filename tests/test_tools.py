@@ -104,6 +104,45 @@ def test_plot_errors_renders_tester_jsonl(tmp_path):
     assert out.exists() and out.stat().st_size > 1000
 
 
+def _distlint_cli():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "distlint_cli", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "distlint.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    return cli
+
+
+def test_distlint_json_format_and_update_budgets(tmp_path, capsys):
+    """tools/distlint.py --format json and --update-budgets, in-process
+    (the jax import cost is already paid), against a throwaway budget dir:
+    no lockfile -> DL203 in the JSON findings and exit 1; --update-budgets
+    writes the lockfile; the re-run is clean with populated cost tables."""
+    import json as _json
+    cli = _distlint_cli()
+    bdir = str(tmp_path / "budgets")
+
+    assert cli.main(["--family", "ep", "--format", "json",
+                     "--budget-dir", bdir]) == 1
+    doc = _json.loads(capsys.readouterr().out)
+    assert any(f["rule"] == "DL203" for f in doc["findings"])
+    assert "moe_fwd" in doc["costs"]["ep"]
+
+    assert cli.main(["--update-budgets", "--family", "ep",
+                     "--budget-dir", bdir]) == 0
+    capsys.readouterr()
+    assert os.path.exists(os.path.join(bdir, "ep.json"))
+
+    assert cli.main(["--family", "ep", "--format", "json",
+                     "--budget-dir", bdir]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["findings"] == [] and doc["errors"] == 0
+    table = doc["costs"]["ep"]["moe_fwd"]
+    assert table["collective_bytes"].get("all-to-all", 0) > 0
+    assert table["peak_bytes"] is None or table["peak_bytes"] > 0
+
+
 def test_ea_convergence_tool_runs():
     """Smoke the EASGD-vs-SGD convergence harness end-to-end (tiny budget,
     2 ranks, throttled links): both algorithms complete, curves land on
