@@ -18,6 +18,7 @@ pure-Python socket IO.  ``recv_tensor(out=...)`` reuses a preallocated buffer
 
 from __future__ import annotations
 
+import itertools
 import json
 import math
 import select
@@ -28,10 +29,25 @@ from typing import Any
 
 import numpy as np
 
+from distlearn_tpu import obs
 from distlearn_tpu.comm import native
 
 _HDR = struct.Struct("<BQ")   # kind, payload length
 _THDR = struct.Struct("<I")   # tensor header length
+
+_CONN_IDS = itertools.count()
+
+
+def _drops():
+    return obs.counter("transport_drops_total",
+                       "connections dropped by recv_any, by cause",
+                       labels=("reason",))
+
+
+def _timeouts():
+    return obs.counter("transport_timeouts_total",
+                       "transport operations that hit a timeout/deadline",
+                       labels=("op",))
 
 
 class Conn:
@@ -52,6 +68,27 @@ class Conn:
         self.bytes_sent = 0
         self.bytes_received = 0
         self.throttle_bps: float | None = None
+        # Telemetry handles resolve once per connection (obs.NULL when the
+        # kill switch is off, so the hot path stays a no-op method call).
+        # Counters mirror bytes_sent/bytes_received exactly: both are
+        # updated by the single thread that does IO on this Conn.
+        self.conn_id = str(next(_CONN_IDS))
+        self._obs = obs.enabled()
+        per_conn = {"labels": ("conn",), "max_children": 256}
+        self._m_sent = obs.counter(
+            "transport_bytes_sent_total",
+            "wire bytes sent per connection (frames + tensor payloads)",
+            **per_conn).labels(conn=self.conn_id)
+        self._m_recv = obs.counter(
+            "transport_bytes_received_total",
+            "wire bytes received per connection",
+            **per_conn).labels(conn=self.conn_id)
+        lat = obs.histogram(
+            "transport_frame_recv_seconds",
+            "whole-frame receive latency (header to last payload byte)",
+            labels=("kind",))
+        self._h_ctrl = lat.labels(kind="control")
+        self._h_tensor = lat.labels(kind="tensor")
 
     def _pace(self, nbytes: int, t0: float):
         if self.throttle_bps:
@@ -85,8 +122,10 @@ class Conn:
                 self.sock.sendall(_HDR.pack(kind, len(payload)))
                 self.sock.sendall(payload)
         except (BlockingIOError, InterruptedError) as e:
+            _timeouts().labels(op="send").inc()
             raise TimeoutError("send timed out (socket timeout)") from e
         self.bytes_sent += _HDR.size + len(payload)
+        self._m_sent.inc(_HDR.size + len(payload))
         self._pace(_HDR.size + len(payload), t0)
 
     def _recv_exact(self, n: int, out: memoryview | None = None,
@@ -114,6 +153,7 @@ class Conn:
                 while got < n:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        _timeouts().labels(op="recv_deadline").inc()
                         raise TimeoutError(
                             "recv deadline exceeded (peer trickling or "
                             "stalled mid-frame)")
@@ -121,6 +161,7 @@ class Conn:
                     try:
                         r = self.sock.recv_into(buf[got:], n - got)
                     except (socket.timeout, BlockingIOError) as e:
+                        _timeouts().labels(op="recv_deadline").inc()
                         raise TimeoutError(
                             "recv deadline exceeded (peer trickling or "
                             "stalled mid-frame)") from e
@@ -136,6 +177,7 @@ class Conn:
                 except OSError:
                     pass
             self.bytes_received += n
+            self._m_recv.inc(n)
             return buf
         try:
             if native.available():
@@ -147,6 +189,7 @@ class Conn:
                             "peer closed connection mid-frame") from e
                     raise
                 self.bytes_received += n
+                self._m_recv.inc(n)
                 return buf
             got = 0
             while got < n:
@@ -158,8 +201,10 @@ class Conn:
                     raise ConnectionError("peer closed connection")
                 got += r
         except BlockingIOError as e:   # SO_RCVTIMEO expired -> EAGAIN
+            _timeouts().labels(op="recv").inc()
             raise TimeoutError("recv timed out (socket timeout)") from e
         self.bytes_received += n
+        self._m_recv.inc(n)
         return buf
 
     def _recv_frame_header(self, deadline: float | None = None
@@ -173,11 +218,14 @@ class Conn:
         self._send_frame(ord("J"), json.dumps(msg).encode())
 
     def recv_msg(self, deadline: float | None = None) -> Any:
+        t0 = time.perf_counter() if self._obs else 0.0
         kind, length = self._recv_frame_header(deadline)
         payload = bytes(self._recv_exact(length, mid_frame=True,
                                          deadline=deadline))
         if kind != ord("J"):
             raise ProtocolError(f"expected control message, got kind {chr(kind)!r}")
+        if self._obs:
+            self._h_ctrl.observe(time.perf_counter() - t0)
         return json.loads(payload)
 
     # -- tensors ------------------------------------------------------------
@@ -193,28 +241,40 @@ class Conn:
                 # zero-copy: numpy buffer goes straight into the writev
                 native.send_tensor_frame(self._fd, ord("T"), meta, arr)
                 self.bytes_sent += nbytes
+                self._m_sent.inc(nbytes)
                 self._pace(nbytes, t0)
                 return
             self.sock.sendall(_HDR.pack(ord("T"), len(meta) + arr.nbytes))
             self.sock.sendall(meta)
             self.sock.sendall(memoryview(arr).cast("B"))
         except (BlockingIOError, InterruptedError) as e:
+            _timeouts().labels(op="send").inc()
             raise TimeoutError("send timed out (socket timeout)") from e
         self.bytes_sent += nbytes
+        self._m_sent.inc(nbytes)
         self._pace(nbytes, t0)
 
-    def recv_tensor(self, out: np.ndarray | None = None) -> np.ndarray:
-        kind, length = self._recv_frame_header()
+    def recv_tensor(self, out: np.ndarray | None = None,
+                    deadline: float | None = None) -> np.ndarray:
+        """Receive one tensor frame.  ``deadline`` (``time.monotonic()``
+        value) bounds the WHOLE frame read, exactly like ``recv_msg`` —
+        a handshake peer that sends the tensor header and then trickles
+        payload bytes must trip :class:`TimeoutError`, not re-arm the
+        kernel timeout forever (the same wedge class the control-frame
+        deadline closes)."""
+        t0 = time.perf_counter() if self._obs else 0.0
+        kind, length = self._recv_frame_header(deadline)
         if kind != ord("T"):
             raise ProtocolError(f"expected tensor, got kind {chr(kind)!r}")
         if length < _THDR.size:
             raise ProtocolError(f"tensor frame too short: {length} bytes")
         hlen = _THDR.unpack(bytes(self._recv_exact(
-            _THDR.size, mid_frame=True)))[0]
+            _THDR.size, mid_frame=True, deadline=deadline)))[0]
         if _THDR.size + hlen > length:
             raise ProtocolError(
                 f"tensor header length {hlen} exceeds frame length {length}")
-        raw = bytes(self._recv_exact(hlen, mid_frame=True))
+        raw = bytes(self._recv_exact(hlen, mid_frame=True,
+                                     deadline=deadline))
         nbytes = length - _THDR.size - hlen
         try:
             header = json.loads(raw)
@@ -239,7 +299,7 @@ class Conn:
                 # Drain the announced payload BEFORE raising: leaving nbytes
                 # unread would desync the stream, and the next recv on this
                 # connection would parse tensor data as a frame header.
-                self._recv_exact(nbytes, mid_frame=True)
+                self._recv_exact(nbytes, mid_frame=True, deadline=deadline)
                 raise ProtocolError(
                     f"recv buffer mismatch: caller expects "
                     f"{out.dtype}{out.shape} but the wire header announces "
@@ -248,16 +308,22 @@ class Conn:
             if not (out.flags.c_contiguous and out.flags.writeable):
                 tmp = np.empty(shape, dtype)
                 self._recv_exact(nbytes, memoryview(tmp).cast("B"),
-                                 mid_frame=True)
+                                 mid_frame=True, deadline=deadline)
                 out[...] = tmp
+                if self._obs:
+                    self._h_tensor.observe(time.perf_counter() - t0)
                 return out
             self._recv_exact(nbytes, memoryview(out).cast("B"),
-                             mid_frame=True)
+                             mid_frame=True, deadline=deadline)
+            if self._obs:
+                self._h_tensor.observe(time.perf_counter() - t0)
             return out
         arr = np.empty(shape, dtype)
         if nbytes:
             self._recv_exact(nbytes, memoryview(arr).cast("B"),
-                             mid_frame=True)
+                             mid_frame=True, deadline=deadline)
+        if self._obs:
+            self._h_tensor.observe(time.perf_counter() - t0)
         return arr
 
     def close(self):
@@ -294,6 +360,7 @@ class Server:
                     c, _ = self.sock.accept()
                 except (socket.timeout, BlockingIOError):
                     # settimeout(0.0) = non-blocking -> BlockingIOError
+                    _timeouts().labels(op="accept").inc()
                     raise TimeoutError(
                         f"accept timed out after {len(new)} of {n} "
                         "connections") from None
@@ -364,6 +431,7 @@ class Server:
                     # partial frame then stall: the stream can't be
                     # resumed mid-frame — drop the peer, keep serving.
                     c.close()
+                    _drops().labels(reason="frame_timeout").inc()
                     if on_drop is not None:
                         on_drop(i, e)
                         raise TimeoutError(
@@ -380,6 +448,8 @@ class Server:
                     # messages
                     clean_eof = (type(e) is ConnectionError
                                  and str(e) == "peer closed connection")
+                    _drops().labels(
+                        reason="eof" if clean_eof else "desync").inc()
                     if on_drop is not None and not clean_eof:
                         on_drop(i, e)
                         raise TimeoutError(
@@ -399,11 +469,17 @@ def connect(host: str, port: int, retries: int = 60,
     listening server (examples/AsyncEASGD.sh backgrounds everything)."""
     last: Exception | None = None
     for _ in range(retries):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
-            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.connect((host, port))
             return Conn(s)
         except OSError as e:
+            # Close the failed socket before sleeping: each refused dial
+            # otherwise leaks an fd for the lifetime of the retry loop
+            # (60 retries x N clients = real fd pressure).
+            s.close()
             last = e
+            obs.counter("transport_connect_retries_total",
+                        "failed connect() dial attempts").inc()
             time.sleep(retry_interval)
     raise ConnectionError(f"could not connect to {host}:{port}: {last}")

@@ -15,6 +15,8 @@ from typing import Any, Callable, Iterator
 import jax
 import numpy as np
 
+from distlearn_tpu import obs
+
 
 def batch_iterator(dataset, sampler, batch_size: int,
                    processor: Callable | None = None) -> Iterator[tuple]:
@@ -34,6 +36,10 @@ def prefetch_to_device(it: Iterator, size: int = 2, sharding=None) -> Iterator:
     split over the data mesh axis so each device receives only its shard).
     """
     queue = collections.deque()
+    # depth as seen at each yield: a gauge stuck at 0 means the consumer
+    # is outrunning batch assembly (compute is starved on infeed)
+    depth = obs.gauge("data_prefetch_depth",
+                      "batches in flight in the device prefetch queue")
 
     def _put(batch):
         if sharding is None:
@@ -44,6 +50,8 @@ def prefetch_to_device(it: Iterator, size: int = 2, sharding=None) -> Iterator:
     for batch in it:
         queue.append(_put(batch))
         if len(queue) >= size:
+            depth.set(len(queue) - 1)
             yield queue.popleft()
     while queue:
+        depth.set(len(queue) - 1)
         yield queue.popleft()

@@ -1,0 +1,44 @@
+"""distobs — dependency-free runtime telemetry for distlearn_tpu.
+
+The runtime organ beside the static pair (distlint: jaxpr/protocol
+rules, distcost: compiled-HLO budgets): counters, gauges, fixed-bucket
+histograms (``obs.core``), spans with an in-memory ring + JSONL spill
+(``obs.trace``), and JSONL/Prometheus export with a ``/healthz``
+liveness endpoint (``obs.export``).  ``tools/diststat.py`` aggregates
+the JSONL trail into p50/p95/p99 tables and run diffs.
+
+Instrumented layers: ``comm/transport.py`` (per-conn wire bytes, frame
+latency, timeout/drop/desync counters), ``parallel/async_ea.py``
+(syncs, handshake spans, evictions/rejoins, inflight, center-apply
+time), ``train/trainer.py`` (step dispatch timing) and
+``data/prefetch.py`` (queue depth).
+
+Kill switch: ``DISTLEARN_OBS=0`` makes every factory return a no-op
+sink; the catalog of metric and span names lives in
+docs/OBSERVABILITY.md.
+"""
+
+from distlearn_tpu.obs.core import (NULL, REGISTRY, configure, counter,
+                                    enabled, gauge, histogram,
+                                    snapshot_record)
+from distlearn_tpu.obs.export import (set_health_source, start_http_server,
+                                      write_snapshot)
+from distlearn_tpu.obs.trace import set_spill, span, spans, traced
+
+__all__ = [
+    "NULL",
+    "REGISTRY",
+    "configure",
+    "counter",
+    "enabled",
+    "gauge",
+    "histogram",
+    "snapshot_record",
+    "set_health_source",
+    "start_http_server",
+    "write_snapshot",
+    "set_spill",
+    "span",
+    "spans",
+    "traced",
+]

@@ -1,0 +1,161 @@
+"""Span API — monotonic start/duration records for the host-side hot
+paths (handshakes, step dispatch, rejoin cycles).
+
+A span is one timed region: ``with obs.span("async_ea.handshake",
+cid=3):`` or ``@obs.traced("data.load")``.  Completed spans land in an
+in-memory ring buffer (bounded; the newest ``ring_size`` survive) and,
+when a spill path is set, are appended as JSONL — the machine-readable
+trail ``tools/diststat.py`` aggregates into p50/p95/p99 tables.
+
+jax bridge: when jax is already imported (this module never imports it
+— obs stays dependency-free), each span also opens a
+``jax.profiler.TraceAnnotation`` so host spans line up with device
+timelines in a captured profile.  The annotation is a cheap no-op while
+no trace is active.
+
+Kill switch: with ``DISTLEARN_OBS=0`` :func:`span` returns a shared
+null context manager — no record, no timing calls, no allocation.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import json
+import os
+import sys
+import threading
+import time
+
+from distlearn_tpu.obs import core
+
+_ring: collections.deque = collections.deque(maxlen=4096)
+_spill_lock = threading.Lock()
+_spill_fh = None
+_spill_path: str | None = None
+#: set False to skip the jax.profiler.TraceAnnotation bridge even when
+#: jax is loaded (micro-bench isolation).
+bridge_jax = True
+
+
+def set_ring_size(n: int):
+    """Resize the in-memory span ring (keeps the newest records)."""
+    global _ring
+    _ring = collections.deque(_ring, maxlen=int(n))
+
+
+def set_spill(path: str | None):
+    """Append completed spans to ``path`` as JSONL (``None`` closes).
+    A no-op while the kill switch is off — a disabled run creates no
+    file."""
+    global _spill_fh, _spill_path
+    with _spill_lock:
+        if _spill_fh is not None:
+            _spill_fh.close()
+            _spill_fh = None
+        _spill_path = None
+        if path and core.enabled():
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            _spill_fh = open(path, "a")
+            _spill_path = path
+
+
+def spill_path() -> str | None:
+    return _spill_path
+
+
+def spans() -> list[dict]:
+    """Snapshot of the in-memory ring (oldest first)."""
+    return list(_ring)
+
+
+def clear():
+    _ring.clear()
+
+
+def _record(rec: dict):
+    _ring.append(rec)
+    if _spill_fh is not None:
+        line = json.dumps(rec) + "\n"
+        with _spill_lock:
+            if _spill_fh is not None:
+                _spill_fh.write(line)
+                _spill_fh.flush()
+
+
+class _Span:
+    __slots__ = ("name", "labels", "_t0", "_ann")
+
+    def __init__(self, name: str, labels: dict):
+        self.name = name
+        self.labels = labels
+        self._ann = None
+
+    def __enter__(self):
+        if bridge_jax and "jax" in sys.modules:
+            try:
+                jax = sys.modules["jax"]
+                self._ann = jax.profiler.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        rec = {"type": "span", "name": self.name, "ts": time.time(),
+               "dur": dur}
+        if self.labels:
+            rec["labels"] = self.labels
+        if exc_type is not None:
+            rec["err"] = exc_type.__name__
+        _record(rec)
+        return False
+
+
+class _NullSpan:
+    """Shared disabled-path span: no timing, no record, reusable."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+def span(name: str, **labels):
+    """Context manager timing one region.  Labels become the span's
+    ``labels`` dict in the JSONL record; exceptions are recorded as an
+    ``err`` field and re-raised."""
+    if not core.enabled():
+        return NULL_SPAN
+    return _Span(name, labels)
+
+
+def traced(name: str | None = None):
+    """Decorator form: ``@traced()`` uses the function's qualname."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with span(label):
+                return fn(*a, **kw)
+
+        return wrapped
+
+    return deco

@@ -38,6 +38,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from distlearn_tpu import obs
 from distlearn_tpu.comm import Conn, ProtocolError, Server, connect
 from distlearn_tpu.utils.logging import print_client, print_server, print_tester
 
@@ -106,6 +107,22 @@ class AsyncEAServer:
             if with_tester else None
         self.center: list[np.ndarray] | None = None
         self.current_client: int | None = None
+        # Telemetry handles (obs.NULL when DISTLEARN_OBS=0) resolve once
+        # per server; ``_obs_on`` gates only work the null sink cannot
+        # absorb (perf_counter pairs).
+        self._obs_on = obs.enabled()
+        self._c_syncs = obs.counter(
+            "async_ea_syncs_total", "deltas applied to the center")
+        self._c_evict = obs.counter(
+            "async_ea_evictions_total", "clients evicted mid-handshake")
+        self._c_rejoin = obs.counter(
+            "async_ea_rejoins_total", "evicted clients re-admitted")
+        self._h_handshake = obs.histogram(
+            "async_ea_handshake_seconds",
+            "full sync handshake (Enter sent to delta validated)")
+        self._h_apply = obs.histogram(
+            "async_ea_center_apply_seconds",
+            "center += delta apply time (host or device path)")
 
     def init_server(self, params: PyTree):
         """Clone params as center, broadcast it to every client
@@ -143,13 +160,18 @@ class AsyncEAServer:
         this with its immutable-publish version (so the serial
         ``sync_server`` API keeps working on a concurrent server, whose
         center leaves are frozen)."""
+        t0 = time.perf_counter() if self._obs_on else 0.0
         for t, d in zip(self.center, deltas):
             t += d              # dtypes equal (checked) — no astype copy
+        self._c_syncs.inc()
+        if self._obs_on:
+            self._h_apply.observe(time.perf_counter() - t0)
 
     def _evict(self, cid: int, why: Exception):
         """Drop a dead/hung client: close both its channels so recv_any stops
         selecting it; remaining clients keep syncing."""
         self.evicted.add(cid)
+        self._c_evict.inc()
         print_server(f"evicting client #{cid}: {why!r}")
         try:
             self.dedicated[cid - 1].close()
@@ -256,6 +278,7 @@ class AsyncEAServer:
         self.evicted.discard(cid)
         self._cid_to_broadcast[cid] = idx
         self.dedicated[cid - 1] = conn
+        self._c_rejoin.inc()
 
     def _readmit(self, idx: int, msg) -> None:
         """Complete one ``Rejoin?`` handshake: validate the claimed id is
@@ -286,12 +309,13 @@ class AsyncEAServer:
                 pass
             return
         try:
-            new.set_timeout(self.handshake_timeout)
-            new.send_msg(REJOIN)
-            for t in self._rejoin_center():
-                new.send_tensor(t)
-            _expect(new, ACK)
-            new.set_timeout(None)
+            with obs.span("async_ea.rejoin", cid=cid):
+                new.set_timeout(self.handshake_timeout)
+                new.send_msg(REJOIN)
+                for t in self._rejoin_center():
+                    new.send_tensor(t)
+                _expect(new, ACK)
+                new.set_timeout(None)
         except (TimeoutError, ConnectionError, ProtocolError, OSError,
                 ValueError) as e:
             print_server(f"rejoin of client #{cid} failed mid-handshake: "
@@ -388,28 +412,39 @@ class AsyncEAServer:
                 continue
             self.current_client = cid
             conn = self.dedicated[cid - 1]  # 1-based ids (ref)
+            t0 = time.perf_counter() if self._obs_on else 0.0
             try:
-                conn.set_timeout(self.handshake_timeout)
-                conn.send_msg(ENTER)
-                print_server(f"current client is #{self.current_client}")
+                with obs.span("async_ea.handshake", cid=cid):
+                    conn.set_timeout(self.handshake_timeout)
+                    conn.send_msg(ENTER)
+                    print_server(f"current client is #{self.current_client}")
 
-                # serverSendCenter (lua :180-196)
-                _expect(conn, CENTER_Q)
-                for t in self.center:
-                    conn.send_tensor(t)
+                    # serverSendCenter (lua :180-196)
+                    _expect(conn, CENTER_Q)
+                    for t in self.center:
+                        conn.send_tensor(t)
 
-                # serverGetUpdateDiff (lua :198-228): receive the FULL delta
-                # before applying any of it, so an eviction mid-stream leaves
-                # the center untouched.
-                _expect(conn, DELTA_Q)
-                conn.send_msg(DELTA)
-                deltas = [conn.recv_tensor() for _ in self.center]
-                self._check_delta(deltas)
-                conn.set_timeout(None)
+                    # serverGetUpdateDiff (lua :198-228): receive the FULL
+                    # delta before applying any of it, so an eviction
+                    # mid-stream leaves the center untouched.  The monotonic
+                    # deadline covers the WHOLE delta stream: a client
+                    # trickling payload bytes re-arms the kernel timeout
+                    # forever, the exact wedge the frame deadline closes for
+                    # control frames.
+                    _expect(conn, DELTA_Q)
+                    conn.send_msg(DELTA)
+                    dl = (None if self.handshake_timeout is None
+                          else time.monotonic() + self.handshake_timeout)
+                    deltas = [conn.recv_tensor(deadline=dl)
+                              for _ in self.center]
+                    self._check_delta(deltas)
+                    conn.set_timeout(None)
             except (TimeoutError, ConnectionError, ProtocolError, OSError,
                     ValueError) as e:   # ValueError: undecodable JSON frame
                 self._evict(cid, e)
                 continue
+            if self._obs_on:
+                self._h_handshake.observe(time.perf_counter() - t0)
             self._apply_delta(deltas)
             print_server(f"received delta from client #{self.current_client}")
             return _rebuild(params, [t.copy() for t in self.center])
@@ -504,6 +539,10 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         self._device = pin_device
         self._dev_center = None
         self._dev_apply = None
+        # mirrors _inflight (same lock holds) so /metrics and /healthz see
+        # the dispatcher's view without taking the dispatcher lock
+        self._g_inflight = obs.gauge(
+            "async_ea_inflight", "sync handshakes currently in flight")
 
     # -- center storage ------------------------------------------------------
     #
@@ -541,20 +580,24 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             return self.center      # immutable published version: no copy
 
     def _apply_delta(self, deltas: list[np.ndarray]):
+        t0 = time.perf_counter() if self._obs_on else 0.0
         if self._dev_center is not None:
             with self._lock:
                 self._dev_center = self._dev_apply(
                     self._dev_center,
                     [jax.device_put(d, self._device) for d in deltas])
                 self._sync_count += 1
-            return
-        with self._apply_lock:      # appliers serialize; readers do not wait
-            new = [t + d for t, d in zip(self.center, deltas)]
-            for t in new:
-                t.flags.writeable = False
-            with self._lock:
-                self.center = new
-                self._sync_count += 1
+        else:
+            with self._apply_lock:  # appliers serialize; readers do not wait
+                new = [t + d for t, d in zip(self.center, deltas)]
+                for t in new:
+                    t.flags.writeable = False
+                with self._lock:
+                    self.center = new
+                    self._sync_count += 1
+        self._c_syncs.inc()
+        if self._obs_on:
+            self._h_apply.observe(time.perf_counter() - t0)
 
     @property
     def syncs_completed(self) -> int:
@@ -614,11 +657,21 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 break
             if token is not None:     # the None stop sentinel never
                 self._inflight -= 1   # incremented _inflight
+                self._g_inflight.dec()
 
     # -- threads -------------------------------------------------------------
+    def _health(self) -> dict:
+        """The ``/healthz`` payload (obs.export): liveness an external
+        prober needs to tell serving from draining from dead.  Reads are
+        lock-free — telemetry tolerates a torn view."""
+        return {"live_clients": self.live_clients,
+                "inflight": self._inflight,
+                "drained": self.drained}
+
     def start(self):
         """Spawn the dispatcher + one worker per client.  Returns self."""
         import threading
+        obs.set_health_source(self._health)
         self._threads = [threading.Thread(target=self._dispatch, daemon=True)]
         self._workers = {
             cid: threading.Thread(target=self._worker, args=(cid,),
@@ -635,6 +688,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             q.put(None)
         for t in self._threads:
             t.join(timeout=10.0)
+        obs.set_health_source(None)
 
     def _rejoin_grace_poll(self) -> bool:
         """True once a re-connection landed (a fresh broadcast conn is
@@ -730,6 +784,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 if cid in self.evicted:
                     continue
                 self._inflight += 1     # token issued; worker will settle it
+                self._g_inflight.inc()
                 self._queues[cid - 1].put(ENTER)
 
     def _worker(self, cid: int):
@@ -743,27 +798,36 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             # this thread is parked on the queue (dispatcher-side
             # evictions never unpark it)
             conn = self.dedicated[cid - 1]
+            t0 = time.perf_counter() if self._obs_on else 0.0
             try:
                 try:
-                    conn.set_timeout(self.handshake_timeout)
-                    conn.send_msg(ENTER)
-                    _expect(conn, CENTER_Q)
-                    for t in self._snapshot():     # stream OUTSIDE the lock
-                        conn.send_tensor(t)
-                    _expect(conn, DELTA_Q)
-                    conn.send_msg(DELTA)
-                    if self._dev_center is None:
-                        if bufs is None:
-                            bufs = [np.empty_like(t) for t in self.center]
-                        # recv_tensor(out=...) itself rejects shape/dtype
-                        # skew (ValueError -> eviction below)
-                        deltas = [conn.recv_tensor(out=b) for b in bufs]
-                    else:
-                        deltas = [conn.recv_tensor() for _ in self.center]
-                    self._check_delta(deltas)   # before ANY apply: a
-                    # config-skewed client is an eviction, never a torn or
-                    # silently-dead worker (the serve loop polls drained)
-                    conn.set_timeout(None)
+                    with obs.span("async_ea.handshake", cid=cid):
+                        conn.set_timeout(self.handshake_timeout)
+                        conn.send_msg(ENTER)
+                        _expect(conn, CENTER_Q)
+                        for t in self._snapshot():  # stream OUTSIDE the lock
+                            conn.send_tensor(t)
+                        _expect(conn, DELTA_Q)
+                        conn.send_msg(DELTA)
+                        # whole-delta-stream deadline: see sync_server
+                        dl = (None if self.handshake_timeout is None
+                              else time.monotonic() + self.handshake_timeout)
+                        if self._dev_center is None:
+                            if bufs is None:
+                                bufs = [np.empty_like(t)
+                                        for t in self.center]
+                            # recv_tensor(out=...) itself rejects shape/dtype
+                            # skew (ValueError -> eviction below)
+                            deltas = [conn.recv_tensor(out=b, deadline=dl)
+                                      for b in bufs]
+                        else:
+                            deltas = [conn.recv_tensor(deadline=dl)
+                                      for _ in self.center]
+                        self._check_delta(deltas)   # before ANY apply: a
+                        # config-skewed client is an eviction, never a torn
+                        # or silently-dead worker (the serve loop polls
+                        # drained)
+                        conn.set_timeout(None)
                 except (TimeoutError, ConnectionError, ProtocolError,
                         OSError, ValueError) as e:
                     # only evict if OUR conn is still the client's current
@@ -783,10 +847,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     if current:
                         return
                     continue                   # stale-conn failure: park
+                if self._obs_on:
+                    self._h_handshake.observe(time.perf_counter() - t0)
                 self._apply_delta(deltas)      # full delta only, atomically
             finally:
                 with self._lock:
                     self._inflight -= 1
+                    self._g_inflight.dec()
 
 
 class AsyncEAClient:
@@ -881,7 +948,11 @@ class AsyncEAClient:
         self.conn.set_timeout(handshake_timeout)
         _expect(self.conn, REJOIN)
         leaves = _leaves(params)
-        self.center = [self.conn.recv_tensor() for _ in leaves]
+        # deadline over the WHOLE center stream: a server stalling
+        # mid-tensor must surface here too, not only on control frames
+        dl = (None if handshake_timeout is None
+              else time.monotonic() + handshake_timeout)
+        self.center = [self.conn.recv_tensor(deadline=dl) for _ in leaves]
         self.conn.send_msg(ACK)
         self.conn.set_timeout(None)
         print_client(self.node, "re-admitted")

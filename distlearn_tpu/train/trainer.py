@@ -26,6 +26,7 @@ Two families:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax, random
 from jax.sharding import PartitionSpec as P
 
+from distlearn_tpu import obs
 from distlearn_tpu.utils.compat import shard_map
 
 from distlearn_tpu.models.core import Model, loss_fn
@@ -44,6 +46,44 @@ from distlearn_tpu.parallel.mesh import MeshTree
 from distlearn_tpu.utils import metrics as metrics_lib
 
 PyTree = Any
+
+
+class _TimedStep:
+    """Telemetry shim around a jitted step: times each host dispatch
+    (async — the wall time to ENQUEUE the program, which is what the
+    scan/cycle builders exist to amortize, not device compute) and counts
+    calls.  ``__getattr__`` forwards everything else to the jitted
+    callable so ``.lower()`` consumers — bench.py, the distcost budget
+    gate — see the unwrapped object and compiled HLO stays identical."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        lat = obs.histogram(
+            "train_step_dispatch_seconds",
+            "host-side dispatch wall time per jitted step call",
+            labels=("step",))
+        cnt = obs.counter("train_steps_total", "jitted step dispatches",
+                          labels=("step",))
+        self._h = lat.labels(step=name)
+        self._c = cnt.labels(step=name)
+
+    def __call__(self, *a, **kw):
+        t0 = time.perf_counter()
+        out = self._fn(*a, **kw)
+        self._c.inc()
+        self._h.observe(time.perf_counter() - t0)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def _timed(fn, name: str):
+    """Wrap a builder's result for telemetry; the raw jitted fn comes back
+    untouched when the kill switch is off (zero indirection disabled)."""
+    if not obs.enabled():
+        return fn
+    return _TimedStep(fn, name)
 
 
 class TrainState(NamedTuple):
@@ -170,7 +210,8 @@ def build_sgd_step(model: Model, tree: MeshTree, lr: float,
                            in_specs=in_specs,
                            out_specs=(specs_ts, P()),
                            check_vma=False)
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return _timed(jax.jit(mapped, donate_argnums=(0,) if donate else ()),
+                  "sgd")
 
 
 def _make_sgd_body(model: Model, tree: MeshTree, lr: float,
@@ -269,7 +310,8 @@ def build_sgd_scan_step(model: Model, tree: MeshTree, lr: float,
                            in_specs=in_specs,
                            out_specs=(specs_ts, P()),
                            check_vma=False)
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return _timed(jax.jit(mapped, donate_argnums=(0,) if donate else ()),
+                  "sgd_scan")
 
 
 def build_sync_step(tree: MeshTree, donate: bool = False) -> Callable:
@@ -290,7 +332,8 @@ def build_sync_step(tree: MeshTree, donate: bool = False) -> Callable:
                           cm=P(axis), rng=P())
     mapped = shard_map(step, mesh=tree.mesh, in_specs=(specs_ts,),
                            out_specs=specs_ts, check_vma=False)
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return _timed(jax.jit(mapped, donate_argnums=(0,) if donate else ()),
+                  "sync")
 
 
 def build_eval_step(model: Model, tree: MeshTree) -> Callable:
@@ -310,7 +353,7 @@ def build_eval_step(model: Model, tree: MeshTree) -> Callable:
                            in_specs=(P(), P(), P(axis), P(axis), P(axis)),
                            out_specs=(P(axis), P()),
                            check_vma=False)
-    return jax.jit(mapped, donate_argnums=(2,))
+    return _timed(jax.jit(mapped, donate_argnums=(2,)), "eval")
 
 
 def reduce_confusion(cm: jax.Array):
@@ -395,7 +438,7 @@ def build_ea_steps(model: Model, tree: MeshTree, lr: float, alpha: float,
         shard_map(ea_round, mesh=tree.mesh, in_specs=(spec_ts,),
                       out_specs=spec_ts, check_vma=False),
         donate_argnums=(0,) if donate else ())
-    return local, rnd
+    return _timed(local, "ea_local"), _timed(rnd, "ea_round")
 
 
 def _make_ea_bodies(model: Model, tree: MeshTree, lr: float, alpha: float,
@@ -468,4 +511,5 @@ def build_ea_cycle(model: Model, tree: MeshTree, lr: float, alpha: float,
                            in_specs=(spec_ts, P(None, axis), P(None, axis)),
                            out_specs=(spec_ts, P(None, axis)),
                            check_vma=False)
-    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return _timed(jax.jit(mapped, donate_argnums=(0,) if donate else ()),
+                  "ea_cycle")

@@ -91,3 +91,10 @@ ASYNC_FLAGS = {
     "testTime": (10, "server-side syncs between test pushes"),
     "save": ("", "checkpoint directory (empty = no checkpointing)"),
 }
+
+OBS_FLAGS = {
+    "obsLog": ("", "telemetry JSONL path: spans spill live, one registry "
+                   "snapshot on exit (empty = off; see docs/OBSERVABILITY.md)"),
+    "obsPort": (0, "serve /metrics + /healthz on 127.0.0.1:PORT "
+                   "(0 = off)"),
+}

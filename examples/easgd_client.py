@@ -11,14 +11,16 @@ Run:  python easgd_client.py --nodeIndex 1 --numNodes 2 --port 9500 ...
 
 from __future__ import annotations
 
-from easgd_common import build_model_and_data, setup_platform, DATA_FLAGS
+from easgd_common import (build_model_and_data, setup_platform, DATA_FLAGS,
+                          obs_finish, obs_setup)
 from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
-                                       EA_FLAGS, ASYNC_FLAGS)
+                                       EA_FLAGS, ASYNC_FLAGS, OBS_FLAGS)
 
 
 def main():
     opt = parse_flags("EASGD worker client.", {
         **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
+        **OBS_FLAGS,
         "autoRejoin": (1, "on a failed sync (server evicted this client, "
                           "connection reset, timeout), re-dial and "
                           "Rejoin? instead of crashing — local params "
@@ -26,6 +28,7 @@ def main():
                           "continues.  --autoRejoin 0 = fail fast"),
     })
     setup_platform(1, opt.tpu)
+    obs_http = obs_setup(opt)
 
     import jax
     import numpy as np
@@ -88,6 +91,7 @@ def main():
                              f"step {step} loss {float(loss):.4f} (synced)")
     print_client(opt.nodeIndex, "done")
     client.close()
+    obs_finish(opt, obs_http)
 
 
 if __name__ == "__main__":

@@ -44,3 +44,28 @@ DATA_FLAGS = {
     "numExamples": (2048, "synthetic dataset size"),
     "model": ("cifar", "model family: cifar (reference convnet) | mnist"),
 }
+
+
+def obs_setup(opt):
+    """Wire ``--obsLog``/``--obsPort`` (utils.flags.OBS_FLAGS): start the
+    span spill and/or the /metrics + /healthz endpoint.  Returns the HTTP
+    server handle (or None) for :func:`obs_finish`."""
+    if not (opt.obsLog or opt.obsPort):
+        return None
+    from distlearn_tpu import obs
+    if opt.obsLog:
+        obs.set_spill(opt.obsLog)
+    return obs.start_http_server(opt.obsPort) if opt.obsPort else None
+
+
+def obs_finish(opt, http=None):
+    """End-of-run telemetry: one registry snapshot appended to the run's
+    JSONL (the counters tools/diststat.py reads) and endpoint shutdown."""
+    if not (opt.obsLog or http):
+        return
+    from distlearn_tpu import obs
+    if opt.obsLog:
+        obs.write_snapshot(opt.obsLog)
+        obs.set_spill(None)
+    if http is not None:
+        http.close()

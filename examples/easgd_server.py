@@ -11,14 +11,16 @@ Run:  python easgd_server.py --numNodes 2 --port 9500 [--tester] ...
 
 from __future__ import annotations
 
-from easgd_common import build_model_and_data, setup_platform, DATA_FLAGS
+from easgd_common import (build_model_and_data, setup_platform, DATA_FLAGS,
+                          obs_finish, obs_setup)
 from distlearn_tpu.utils.flags import (parse_flags, NODE_FLAGS, TRAIN_FLAGS,
-                                       EA_FLAGS, ASYNC_FLAGS)
+                                       EA_FLAGS, ASYNC_FLAGS, OBS_FLAGS)
 
 
 def main():
     opt = parse_flags("EASGD parameter server.", {
         **NODE_FLAGS, **TRAIN_FLAGS, **EA_FLAGS, **ASYNC_FLAGS, **DATA_FLAGS,
+        **OBS_FLAGS,
         "numSyncs": (0, "total syncs to serve (0 = numEpochs*steps/tau per node)"),
         "tester": (False, "open the test channel and expect a tester process"),
         "concurrent": (False, "serve clients on overlapped per-client "
@@ -31,6 +33,7 @@ def main():
                              "when clients may die without cleanup)"),
     })
     setup_platform(1, opt.tpu)
+    obs_http = obs_setup(opt)
 
     from distlearn_tpu.parallel.async_ea import (AsyncEAServer,
                                                  AsyncEAServerConcurrent)
@@ -99,6 +102,7 @@ def main():
         print_server("done")
         srv.stop()
         srv.close()
+        obs_finish(opt, obs_http)
         return
 
     srv = AsyncEAServer(opt.host, opt.port, opt.numNodes,
@@ -128,6 +132,7 @@ def main():
         ckpt.save_checkpoint(opt.save, served, {"center": params})
     print_server("done")
     srv.close()
+    obs_finish(opt, obs_http)
 
 
 if __name__ == "__main__":

@@ -162,3 +162,99 @@ def test_ea_convergence_tool_runs():
         files = os.listdir(tmp)
         assert any(f.startswith("sgd") for f in files), files
         assert any(f.startswith("ea_tau") for f in files), files
+
+
+# -- tools/diststat.py -------------------------------------------------------
+
+def _fixture_run(path, syncs=3, base_dur=0.010):
+    """Write a small but structurally complete obs JSONL run: spans (one
+    errored), two snapshots (diststat must use the LAST), counters with
+    and without labels, a gauge, a histogram."""
+    import json as _json
+    recs = []
+    for i in range(syncs):
+        recs.append({"type": "span", "name": "async_ea.handshake",
+                     "ts": 1000.0 + i, "dur": base_dur * (i + 1),
+                     "labels": {"cid": 1}})
+    recs.append({"type": "span", "name": "async_ea.handshake",
+                 "ts": 1000.5, "dur": 0.5, "err": "TimeoutError"})
+    mk = lambda n: {"type": "snapshot", "ts": 2000.0 + n, "metrics": [
+        {"name": "async_ea_syncs_total", "kind": "counter", "help": "",
+         "labelnames": [], "samples": [{"labels": {}, "value": n}]},
+        {"name": "transport_bytes_sent_total", "kind": "counter",
+         "help": "", "labelnames": ["conn"],
+         "samples": [{"labels": {"conn": "0"}, "value": 100 * n},
+                     {"labels": {"conn": "1"}, "value": 50 * n}]},
+        {"name": "async_ea_inflight", "kind": "gauge", "help": "",
+         "labelnames": [], "samples": [{"labels": {}, "value": 0}]},
+        {"name": "transport_frame_recv_seconds", "kind": "histogram",
+         "help": "", "labelnames": [],
+         "samples": [{"labels": {}, "sum": 0.25 * n, "count": 5 * n,
+                      "buckets": {"0.001": 2 * n, "1.0": 3 * n},
+                      "inf": 0}]},
+    ]}
+    recs.append(mk(1))       # an intermediate snapshot...
+    recs.append(mk(syncs))   # ...must be superseded by the final one
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(_json.dumps(r) + "\n")
+        fh.write("{torn line\n")   # live-run tail: must be skipped
+
+
+def test_diststat_summarize(tmp_path, capsys):
+    import json as _json
+    import diststat
+
+    log = str(tmp_path / "run.jsonl")
+    _fixture_run(log, syncs=3)
+    assert diststat.main(["summarize", log, "--format", "json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    hs = doc["spans"]["async_ea.handshake"]
+    assert hs["count"] == 4 and hs["errors"] == 1
+    assert abs(hs["p50"] - 0.030) < 1e-9        # sorted durs: 10/20/30/500ms
+    assert abs(hs["p95"] - 0.5) < 1e-9
+    assert doc["counter_totals"]["async_ea_syncs_total"] == 3   # LAST snapshot
+    assert doc["counter_totals"]["transport_bytes_sent_total"] == 450
+    assert doc["counters"]['transport_bytes_sent_total{conn="0"}'] == 300
+    assert doc["gauges"]["async_ea_inflight"] == 0
+    assert doc["histograms"]["transport_frame_recv_seconds"]["count"] == 15
+    # text mode renders without blowing up
+    assert diststat.main(["summarize", log]) == 0
+    out = capsys.readouterr().out
+    assert "async_ea.handshake" in out and "p95" in out
+
+
+def test_diststat_summarize_merges_files(tmp_path):
+    import diststat
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _fixture_run(a, syncs=2)
+    _fixture_run(b, syncs=3)
+    doc = diststat.summarize_run([a, b])
+    # spans concatenate; counters sum across files (per-process logs)
+    assert doc["spans"]["async_ea.handshake"]["count"] == 7
+    assert doc["counter_totals"]["async_ea_syncs_total"] == 5
+
+
+def test_diststat_diff(tmp_path, capsys):
+    import json as _json
+    import diststat
+
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _fixture_run(a, syncs=2, base_dur=0.010)
+    _fixture_run(b, syncs=4, base_dur=0.020)
+    assert diststat.main(["diff", a, b, "--format", "json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    row = doc["counters"]["async_ea_syncs_total"]
+    assert row == {"a": 2, "b": 4, "delta": 2}
+    assert doc["spans"]["async_ea.handshake"]["count"] == {"a": 3, "b": 5}
+    assert diststat.main(["diff", a, b]) == 0          # text mode
+    assert "async_ea_syncs_total" in capsys.readouterr().out
+
+
+def test_diststat_cli_errors(tmp_path, capsys):
+    import diststat
+
+    assert diststat.main([]) == 2                      # no subcommand
+    assert diststat.main(["summarize",
+                          str(tmp_path / "missing.jsonl")]) == 2

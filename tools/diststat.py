@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""diststat — aggregate a distlearn obs JSONL run into latency tables,
+or diff two runs.
+
+The obs subsystem (distlearn_tpu/obs/) spills span records and registry
+snapshots to JSONL; this tool turns that trail into the numbers
+docs/PERF.md used to recompute by hand:
+
+    python tools/diststat.py summarize run.jsonl [more.jsonl ...]
+    python tools/diststat.py summarize run.jsonl --format json
+    python tools/diststat.py diff before.jsonl after.jsonl
+
+``summarize`` reports per-span-name count/p50/p95/p99/total (exact —
+computed from the individual span durations, not histogram buckets),
+final counter values (per label set and summed per name), gauges, and
+histogram summaries.  Multiple files merge: spans concatenate, counters
+sum across files (one file per process is the normal layout — server
+and each client spill separately).  ``diff`` subtracts run A's counter
+totals and span quantiles from run B's.
+
+Record schema: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    """Nearest-rank percentile on a sorted copy (small-n friendly)."""
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def _label_key(labels: dict) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"'
+                          for k, v in sorted(labels.items())) + "}"
+
+
+def load_run(paths: list[str]) -> dict:
+    """Parse one run (1+ JSONL files) into ``{"spans": {...},
+    "counters": {...}, "counter_totals": {...}, "gauges": {...},
+    "histograms": {...}, "records": n}``."""
+    spans: dict[str, list[float]] = {}
+    span_errs: dict[str, int] = {}
+    counters: dict[str, float] = {}
+    counter_totals: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    nrec = 0
+    for path in paths:
+        last_snap = None
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue          # torn tail line of a live run
+                nrec += 1
+                if rec.get("type") == "span":
+                    spans.setdefault(rec["name"], []).append(
+                        float(rec["dur"]))
+                    if rec.get("err"):
+                        span_errs[rec["name"]] = \
+                            span_errs.get(rec["name"], 0) + 1
+                elif rec.get("type") == "snapshot":
+                    last_snap = rec
+        if last_snap is None:
+            continue
+        for fam in last_snap.get("metrics", []):
+            name, kind = fam["name"], fam["kind"]
+            for s in fam.get("samples", []):
+                key = name + _label_key(s.get("labels", {}))
+                if kind == "counter":
+                    counters[key] = counters.get(key, 0) + s["value"]
+                    counter_totals[name] = \
+                        counter_totals.get(name, 0) + s["value"]
+                elif kind == "gauge":
+                    gauges[key] = s["value"]
+                elif kind == "histogram":
+                    h = hists.setdefault(key, {"sum": 0.0, "count": 0})
+                    h["sum"] += s["sum"]
+                    h["count"] += s["count"]
+    return {"records": nrec, "spans": spans, "span_errs": span_errs,
+            "counters": counters, "counter_totals": counter_totals,
+            "gauges": gauges, "histograms": hists}
+
+
+def summarize_run(paths: list[str]) -> dict:
+    run = load_run(paths)
+    span_tab = {}
+    for name, durs in sorted(run["spans"].items()):
+        span_tab[name] = {
+            "count": len(durs),
+            "errors": run["span_errs"].get(name, 0),
+            "p50": _percentile(durs, 50),
+            "p95": _percentile(durs, 95),
+            "p99": _percentile(durs, 99),
+            "total": sum(durs),
+        }
+    hist_tab = {}
+    for key, h in sorted(run["histograms"].items()):
+        mean = h["sum"] / h["count"] if h["count"] else float("nan")
+        hist_tab[key] = {"count": h["count"], "sum": h["sum"], "mean": mean}
+    return {"records": run["records"], "spans": span_tab,
+            "counters": dict(sorted(run["counters"].items())),
+            "counter_totals": dict(sorted(run["counter_totals"].items())),
+            "gauges": dict(sorted(run["gauges"].items())),
+            "histograms": hist_tab}
+
+
+def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
+    a, b = summarize_run(a_paths), summarize_run(b_paths)
+    counters = {}
+    for name in sorted(set(a["counter_totals"]) | set(b["counter_totals"])):
+        av = a["counter_totals"].get(name, 0)
+        bv = b["counter_totals"].get(name, 0)
+        counters[name] = {"a": av, "b": bv, "delta": bv - av}
+    spans = {}
+    for name in sorted(set(a["spans"]) | set(b["spans"])):
+        sa = a["spans"].get(name, {})
+        sb = b["spans"].get(name, {})
+        spans[name] = {
+            "count": {"a": sa.get("count", 0), "b": sb.get("count", 0)},
+            "p50_delta": sb.get("p50", float("nan"))
+            - sa.get("p50", float("nan")),
+            "p95_delta": sb.get("p95", float("nan"))
+            - sa.get("p95", float("nan")),
+        }
+    return {"counters": counters, "spans": spans}
+
+
+def _fmt_s(v: float) -> str:
+    if v != v:
+        return "nan"
+    if abs(v) >= 1.0:
+        return f"{v:.3f}s"
+    if abs(v) >= 1e-3:
+        return f"{v * 1e3:.2f}ms"
+    return f"{v * 1e6:.1f}us"
+
+
+def _print_summary(doc: dict):
+    if doc["spans"]:
+        print(f"{'span':<40} {'count':>7} {'p50':>10} {'p95':>10} "
+              f"{'p99':>10} {'total':>10} {'err':>5}")
+        for name, row in doc["spans"].items():
+            print(f"{name:<40} {row['count']:>7} {_fmt_s(row['p50']):>10} "
+                  f"{_fmt_s(row['p95']):>10} {_fmt_s(row['p99']):>10} "
+                  f"{_fmt_s(row['total']):>10} {row['errors']:>5}")
+        print()
+    if doc["counters"]:
+        print("counters:")
+        for key, v in doc["counters"].items():
+            print(f"  {key} = {v:g}")
+        for name, v in doc["counter_totals"].items():
+            if name + "{" in "".join(doc["counters"]):
+                print(f"  {name} (sum over labels) = {v:g}")
+        print()
+    if doc["gauges"]:
+        print("gauges:")
+        for key, v in doc["gauges"].items():
+            print(f"  {key} = {v:g}")
+        print()
+    if doc["histograms"]:
+        print("histograms:")
+        for key, row in doc["histograms"].items():
+            print(f"  {key}: count={row['count']} "
+                  f"mean={_fmt_s(row['mean'])} sum={_fmt_s(row['sum'])}")
+
+
+def _print_diff(doc: dict):
+    if doc["counters"]:
+        print(f"{'counter':<44} {'a':>12} {'b':>12} {'delta':>12}")
+        for name, row in doc["counters"].items():
+            print(f"{name:<44} {row['a']:>12g} {row['b']:>12g} "
+                  f"{row['delta']:>+12g}")
+        print()
+    if doc["spans"]:
+        print(f"{'span':<40} {'count a/b':>12} {'dp50':>10} {'dp95':>10}")
+        for name, row in doc["spans"].items():
+            cnt = f"{row['count']['a']}/{row['count']['b']}"
+            print(f"{name:<40} {cnt:>12} {_fmt_s(row['p50_delta']):>10} "
+                  f"{_fmt_s(row['p95_delta']):>10}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="diststat", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd")
+    ps = sub.add_parser("summarize", help="aggregate one run's JSONL trail")
+    ps.add_argument("paths", nargs="+")
+    ps.add_argument("--format", choices=("text", "json"), default="text")
+    pd = sub.add_parser("diff", help="counter/latency deltas of two runs")
+    pd.add_argument("a")
+    pd.add_argument("b")
+    pd.add_argument("--format", choices=("text", "json"), default="text")
+    args = p.parse_args(argv)
+    if args.cmd is None:
+        p.print_usage(sys.stderr)
+        return 2
+    try:
+        if args.cmd == "summarize":
+            doc = summarize_run(args.paths)
+        else:
+            doc = diff_runs([args.a], [args.b])
+    except OSError as e:
+        print(f"diststat: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    elif args.cmd == "summarize":
+        _print_summary(doc)
+    else:
+        _print_diff(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
