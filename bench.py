@@ -985,6 +985,133 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
     return out
 
 
+#: EASGD-shaped pytree leaf lists for the wire microbench — the EXACT
+#: leaf shapes of the repo's models (distlearn_tpu/models/, hardcoded so
+#: the bench stays chip-free and jax-import-free): many small bias/bn
+#: vectors + a few large kernels, NOT one flat blob, since per-leaf
+#: framing overhead is what the packed wire removes.  fp32 sizes:
+#: mnist_cnn 43 KB / 6 leaves, cifar_convnet 17.3 MB / 26 leaves.
+_WIRE_PARAM_SETS = {
+    "mnist_cnn": [(16,), (5, 5, 1, 16), (16,), (5, 5, 16, 16),
+                  (10,), (400, 10)],
+    "cifar_convnet": [
+        (64,), (64,), (128,), (128,), (256,), (256,), (512,), (512,),
+        (64,), (5, 5, 3, 64), (128,), (5, 5, 64, 128),
+        (256,), (5, 5, 128, 256), (512,), (5, 5, 256, 512),
+        (10,), (2048, 10),
+        (64,), (64,), (128,), (128,), (256,), (256,), (512,), (512,)],
+}
+
+
+def host_wire_bench(iters: int = 20, reps: int = 3):
+    """Chip-free host-comm wire microbench (runs even while the TPU tunnel
+    is down): one EASGD-shaped echo sync — leaf list up, echo back down —
+    over localhost TCP, per wire mode.  ``perleaf`` is the legacy one
+    frame per leaf ('T'); ``raw``/``fp16``/``int8`` are the packed 'P'
+    frame per codec (comm/wire.py).  Reports syncs/s (best of ``reps``
+    timed windows — localhost on a shared CPU is noisy) and measured wire
+    bytes/sync from the Conn byte counters.
+
+    Two regimes per param set: the raw loopback (syscall/framing-bound at
+    MNIST scale, memcpy-bound at CIFAR scale — coalescing wins where
+    framing dominates) and an emulated fixed-bandwidth link via
+    ``Conn.throttle_bps`` (the multi-host regime, where the quantized
+    codecs' byte reduction converts directly into syncs/s)."""
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.comm import Server, connect
+
+    modes = ("perleaf", "raw", "fp16", "int8")
+
+    def measure(leaves, mode, k, r, bps=None):
+        srv = Server("127.0.0.1", 0)
+        errs: list = []
+        nsync = 2 + r * k             # warmup + timed windows
+
+        def echo():
+            try:
+                c = srv.accept(1)[0]
+                if bps:
+                    c.throttle_bps = bps
+                bufs = [np.empty(a.shape, a.dtype) for a in leaves]
+                for _ in range(nsync):
+                    got = c.recv_tensors(out=bufs)
+                    if mode == "perleaf":
+                        for a in got:
+                            c.send_tensor(a)
+                    else:
+                        c.send_tensors(got, codec=mode)
+                c.close()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        th = threading.Thread(target=echo, daemon=True)
+        th.start()
+        c = connect("127.0.0.1", srv.port)
+        if bps:
+            c.throttle_bps = bps
+        bufs = [np.empty(a.shape, a.dtype) for a in leaves]
+
+        def one_sync():
+            if mode == "perleaf":
+                for a in leaves:
+                    c.send_tensor(a)
+                for b in bufs:
+                    c.recv_tensor(out=b)
+            else:
+                c.send_tensors(leaves, codec=mode)
+                c.recv_tensors(out=bufs)
+
+        for _ in range(2):
+            one_sync()
+        base = c.bytes_sent + c.bytes_received
+        best = float("inf")
+        for _ in range(r):
+            t0 = _t.perf_counter()
+            for _ in range(k):
+                one_sync()
+            best = min(best, _t.perf_counter() - t0)
+        wire_bytes = (c.bytes_sent + c.bytes_received - base) / (r * k)
+        c.close()
+        th.join(timeout=120)
+        srv.close()
+        if errs:
+            raise errs[0]
+        return {"syncs_per_sec": k / best, "bytes_per_sync": wire_bytes}
+
+    bps = float(os.environ.get("BENCH_HOST_EMULATED_LINK_MB_S",
+                               "200")) * 1e6
+    out: dict = {}
+    for set_name, shapes in _WIRE_PARAM_SETS.items():
+        leaves = [np.random.RandomState(i).randn(*s).astype(np.float32)
+                  for i, s in enumerate(shapes)]
+        rows: dict = {}
+        for mode in modes:
+            rows[mode] = measure(leaves, mode, iters, reps)
+        # emulated-link regime: few iters — each sync costs payload/bps
+        emu_iters = max(2, int(bps * 0.05 / (2 * sum(a.nbytes
+                                                     for a in leaves))))
+        for mode in ("perleaf", "int8"):
+            rows[mode + "_emulated"] = measure(leaves, mode,
+                                               min(emu_iters, iters), 1,
+                                               bps=bps)
+        rows["emulated_link_mb_s"] = bps / 1e6
+        rows["logical_bytes_per_sync"] = 2 * sum(a.nbytes for a in leaves)
+        rows["leaves"] = len(leaves)
+        rows["packed_raw_speedup"] = (rows["raw"]["syncs_per_sec"]
+                                      / rows["perleaf"]["syncs_per_sec"])
+        rows["int8_byte_reduction"] = (rows["perleaf"]["bytes_per_sync"]
+                                       / rows["int8"]["bytes_per_sync"])
+        rows["int8_emulated_speedup"] = (
+            rows["int8_emulated"]["syncs_per_sec"]
+            / rows["perleaf_emulated"]["syncs_per_sec"])
+        out[set_name] = rows
+    return out
+
+
 def async_ea_bench(param_mb: int = 8, n_clients: int = 2,
                    syncs_per_client: int = 10,
                    server_impl: str = "serial"):
@@ -1825,6 +1952,22 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"[bench] host allreduce bench failed: {e}",
                   file=sys.stderr)
+
+    # --- host wire path: per-leaf vs packed/quantized frames -----------------
+    if os.environ.get("BENCH_SKIP_WIRE") != "1":
+        try:
+            details["host_wire"] = host_wire_bench(
+                int(os.environ.get("BENCH_WIRE_ITERS", "20")))
+            for set_name, w in details["host_wire"].items():
+                print(f"[bench] wire {set_name} ({w['leaves']} leaves): "
+                      f"perleaf {w['perleaf']['syncs_per_sec']:.1f} -> "
+                      f"packed {w['raw']['syncs_per_sec']:.1f} syncs/s "
+                      f"({w['packed_raw_speedup']:.2f}x); int8 "
+                      f"{w['int8']['bytes_per_sync']/1e6:.2f} MB/sync "
+                      f"({w['int8_byte_reduction']:.2f}x fewer bytes)",
+                      file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] host wire bench failed: {e}", file=sys.stderr)
 
     # --- AsyncEA parameter-server protocol throughput ------------------------
     if os.environ.get("BENCH_SKIP_ASYNC") != "1":

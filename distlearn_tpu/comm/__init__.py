@@ -4,8 +4,9 @@ ICI collectives (distlearn_tpu.parallel.mesh); this package is the control
 plane for the asynchronous parameter-server path and multi-host side-channel.
 """
 
+from distlearn_tpu.comm import wire
 from distlearn_tpu.comm.transport import Conn, Server, connect, ProtocolError
 from distlearn_tpu.comm.ring import LocalhostRing, Ring
 
 __all__ = ["Conn", "Server", "connect", "ProtocolError", "Ring",
-           "LocalhostRing"]
+           "LocalhostRing", "wire"]

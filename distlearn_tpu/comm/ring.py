@@ -80,6 +80,8 @@ class _Sender:
             try:
                 if kind == "T":
                     self._conn.send_tensor(payload)
+                elif kind == "P":
+                    self._conn.send_tensors(payload)
                 else:
                     self._conn.send_msg(payload)
             except BaseException as e:  # noqa: BLE001 — surfaced in flush
@@ -89,6 +91,10 @@ class _Sender:
 
     def put_tensor(self, arr: np.ndarray):
         self._q.put(("T", arr))
+
+    def put_tensors(self, leaves: list):
+        """Enqueue a whole leaf list as ONE packed 'P' frame."""
+        self._q.put(("P", leaves))
 
     def put_msg(self, msg):
         self._q.put(("J", msg))
@@ -296,25 +302,23 @@ class Ring:
 
     def scatter(self, value: PyTree) -> PyTree:
         """Rank 0's values broadcast to every rank (ref ``tree.scatter``):
-        pipelined around the ring, each rank forwards to its successor."""
+        the whole leaf list travels as ONE packed frame per hop, forwarded
+        around the ring by each rank."""
         leaves = [np.asarray(x) for x in _jtu.tree_leaves(value)]
-        out = []
         last = self.num_nodes - 1
-        for a in leaves:
-            if self.num_nodes == 1:
-                out.append(np.array(a, copy=True, order="C"))
-                continue
-            if self.rank == 0:
-                buf = np.ascontiguousarray(a)
-                self._sender.put_tensor(buf)
+        if self.num_nodes == 1:
+            out = [np.array(a, copy=True, order="C") for a in leaves]
+        elif self.rank == 0:
+            bufs = [np.ascontiguousarray(a) for a in leaves]
+            self._sender.put_tensors(bufs)
+            self._sender.flush()
+            out = [np.array(b, copy=True, order="C") for b in bufs]
+        else:
+            out = self._pred.recv_tensors(
+                out=[np.empty(a.shape, a.dtype) for a in leaves])
+            if self.rank != last:
+                self._sender.put_tensors(out)
                 self._sender.flush()
-                out.append(np.array(buf, copy=True, order="C"))
-            else:
-                buf = self._pred.recv_tensor(out=np.empty(a.shape, a.dtype))
-                if self.rank != last:
-                    self._sender.put_tensor(buf)
-                    self._sender.flush()
-                out.append(buf)
         treedef = _jtu.tree_structure(value)
         return _jtu.tree_unflatten(treedef, out)
 

@@ -8,6 +8,9 @@ Wire protocol (shared with the native C++ backend in src/comm/distcomm.cpp):
     kind 'J': payload is UTF-8 JSON (control messages)
     kind 'T': payload is hlen:u32le | header[hlen] | raw tensor bytes,
               header = JSON {"dtype": str, "shape": [int...]}
+    kind 'P': payload is hlen:u32le | manifest[hlen] | packed leaf bytes —
+              a whole tensor LIST in one frame (manifest schema and the
+              raw/fp16/int8 leaf codecs: distlearn_tpu.comm.wire)
 
 Connection management (listen/accept/connect/poll) stays in Python; the
 byte-moving hot path (frame assembly, big-buffer send/recv loops) dispatches
@@ -30,10 +33,14 @@ from typing import Any
 import numpy as np
 
 from distlearn_tpu import obs
-from distlearn_tpu.comm import native
+from distlearn_tpu.comm import native, wire
 
 _HDR = struct.Struct("<BQ")   # kind, payload length
 _THDR = struct.Struct("<I")   # tensor header length
+
+# sendmsg iovec fan-in cap, kept well under every Linux IOV_MAX (1024);
+# longer buffer lists loop.
+_IOV_MAX = 512
 
 _CONN_IDS = itertools.count()
 
@@ -48,6 +55,39 @@ def _timeouts():
     return obs.counter("transport_timeouts_total",
                        "transport operations that hit a timeout/deadline",
                        labels=("op",))
+
+
+def _wire_frames():
+    return obs.counter("wire_packed_frames_total",
+                       "packed 'P' tensor-list frames sent, by codec",
+                       labels=("codec",))
+
+
+def _wire_bytes():
+    return obs.counter("wire_packed_bytes_total",
+                       "wire bytes of packed frames sent "
+                       "(frame header + manifest + data), by codec",
+                       labels=("codec",))
+
+
+def _wire_logical():
+    return obs.counter("wire_logical_bytes_total",
+                       "pre-encoding logical tensor bytes shipped in "
+                       "packed frames, by codec",
+                       labels=("codec",))
+
+
+def _wire_ratio():
+    return obs.gauge("wire_compression_ratio",
+                     "logical/wire byte ratio of the most recent packed "
+                     "frame, by codec",
+                     labels=("codec",))
+
+
+def _wire_pack_secs():
+    return obs.histogram("wire_pack_seconds",
+                         "time to encode one packed frame "
+                         "(manifest build + quantization)")
 
 
 class Conn:
@@ -113,14 +153,35 @@ class Conn:
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
 
     # -- low-level framing --------------------------------------------------
+    def _sendv(self, bufs: list):
+        """Vectored full-send of a buffer list via ``sendmsg`` — the frame
+        header and payload(s) leave in ONE syscall (and, with TCP_NODELAY,
+        one packet when they fit): two back-to-back ``send()`` calls ship
+        the 9-byte header as its own packet per control message.  Handles
+        partial sends by slicing the straddled view and continuing."""
+        vs = []
+        for b in bufs:
+            v = b if isinstance(b, memoryview) else memoryview(b)
+            if v.format != "B" or v.ndim != 1:
+                v = v.cast("B")
+            if v.nbytes:
+                vs.append(v)
+        i = 0
+        while i < len(vs):
+            sent = self.sock.sendmsg(vs[i:i + _IOV_MAX])
+            while i < len(vs) and sent >= vs[i].nbytes:
+                sent -= vs[i].nbytes
+                i += 1
+            if sent:
+                vs[i] = vs[i][sent:]
+
     def _send_frame(self, kind: int, payload: bytes | memoryview):
         t0 = time.perf_counter()
         try:
             if native.available():
                 native.send_frame(self._fd, kind, payload)
             else:
-                self.sock.sendall(_HDR.pack(kind, len(payload)))
-                self.sock.sendall(payload)
+                self._sendv([_HDR.pack(kind, len(payload)), payload])
         except (BlockingIOError, InterruptedError) as e:
             _timeouts().labels(op="send").inc()
             raise TimeoutError("send timed out (socket timeout)") from e
@@ -230,7 +291,11 @@ class Conn:
 
     # -- tensors ------------------------------------------------------------
     def send_tensor(self, arr: np.ndarray):
-        arr = np.ascontiguousarray(arr)
+        # copy ONLY when the buffer is not already contiguous — an
+        # unconditional ascontiguousarray would still be cheap, but this
+        # makes the zero-copy contract explicit for the 100 MB-leaf syncs
+        if not (isinstance(arr, np.ndarray) and arr.flags.c_contiguous):
+            arr = np.ascontiguousarray(arr)
         header = json.dumps({"dtype": arr.dtype.name,
                              "shape": list(arr.shape)}).encode()
         meta = _THDR.pack(len(header)) + header
@@ -244,9 +309,8 @@ class Conn:
                 self._m_sent.inc(nbytes)
                 self._pace(nbytes, t0)
                 return
-            self.sock.sendall(_HDR.pack(ord("T"), len(meta) + arr.nbytes))
-            self.sock.sendall(meta)
-            self.sock.sendall(memoryview(arr).cast("B"))
+            self._sendv([_HDR.pack(ord("T"), len(meta) + arr.nbytes),
+                         meta, memoryview(arr).cast("B")])
         except (BlockingIOError, InterruptedError) as e:
             _timeouts().labels(op="send").inc()
             raise TimeoutError("send timed out (socket timeout)") from e
@@ -266,6 +330,13 @@ class Conn:
         kind, length = self._recv_frame_header(deadline)
         if kind != ord("T"):
             raise ProtocolError(f"expected tensor, got kind {chr(kind)!r}")
+        return self._recv_tensor_body(length, out, deadline, t0)
+
+    def _recv_tensor_body(self, length: int, out: np.ndarray | None,
+                          deadline: float | None, t0: float) -> np.ndarray:
+        """Body of one ``'T'`` frame whose header was already consumed
+        (shared by :meth:`recv_tensor` and the legacy per-leaf branch of
+        :meth:`recv_tensors`)."""
         if length < _THDR.size:
             raise ProtocolError(f"tensor frame too short: {length} bytes")
         hlen = _THDR.unpack(bytes(self._recv_exact(
@@ -325,6 +396,157 @@ class Conn:
         if self._obs:
             self._h_tensor.observe(time.perf_counter() - t0)
         return arr
+
+    # -- packed tensor lists (kind 'P', distlearn_tpu.comm.wire) ------------
+    def send_tensors(self, leaves, codec: str = "raw", packed: bool = True):
+        """Ship a whole tensor list.  ``packed=True`` coalesces it into ONE
+        ``'P'`` frame (O(1) frames per sync); ``packed=False`` degrades to
+        the legacy per-leaf ``'T'`` frames for peers that never advertised
+        packed support (quantized codecs require the packed frame — the
+        ``'T'`` header has nowhere to carry a scale)."""
+        if not packed:
+            if codec not in (None, "raw"):
+                raise ValueError(
+                    f"codec {codec!r} requires the packed frame; legacy "
+                    "per-leaf frames are raw-only")
+            for a in leaves:
+                self.send_tensor(a)
+            return
+        if not len(leaves):
+            return    # zero leaves = zero frames, matching the legacy path
+        t0 = time.perf_counter() if self._obs else 0.0
+        payload = wire.encode_leaves(leaves, codec)
+        if self._obs:
+            _wire_pack_secs().observe(time.perf_counter() - t0)
+        self.send_packed(payload)
+
+    def send_packed(self, payload: "wire.PackedPayload"):
+        """Send one pre-encoded packed frame (see ``wire.encode_leaves``;
+        the AsyncEA client pre-encodes so the error-feedback residual can
+        be computed before the frame leaves).  Pacing budgets the WHOLE
+        frame, not per leaf — under ``throttle_bps`` a packed sync sleeps
+        out the same wire-time a per-leaf sync would."""
+        manifest = json.dumps(payload.manifest).encode()
+        meta = _THDR.pack(len(manifest)) + manifest
+        total = len(meta) + payload.wire_nbytes
+        t0 = time.perf_counter()
+        try:
+            # one vectored send: frame header + manifest + every leaf
+            # buffer (raw leaves are zero-copy views of the caller's
+            # arrays; no staging copy of the data region is ever built)
+            self._sendv([_HDR.pack(ord("P"), total), meta]
+                        + [memoryview(b).cast("B")
+                           for b in payload.bufs if b.nbytes])
+        except (BlockingIOError, InterruptedError) as e:
+            _timeouts().labels(op="send").inc()
+            raise TimeoutError("send timed out (socket timeout)") from e
+        nbytes = _HDR.size + total
+        self.bytes_sent += nbytes
+        self._m_sent.inc(nbytes)
+        if self._obs:
+            _wire_frames().labels(codec=payload.codec).inc()
+            _wire_bytes().labels(codec=payload.codec).inc(nbytes)
+            _wire_logical().labels(codec=payload.codec).inc(
+                payload.logical_nbytes)
+            _wire_ratio().labels(codec=payload.codec).set(
+                payload.logical_nbytes / nbytes if nbytes else 0.0)
+        self._pace(nbytes, t0)
+
+    def recv_tensors(self, out: list | None = None, n: int | None = None,
+                     deadline: float | None = None) -> list[np.ndarray]:
+        """Receive a tensor list: ONE packed ``'P'`` frame or ``n`` legacy
+        per-leaf ``'T'`` frames — auto-detected from the first frame
+        header, so a receiver negotiated down to the legacy wire needs no
+        separate code path.  ``out`` reuses preallocated buffers (logical
+        dtype — quantized leaves are decoded into it); ``n`` is required
+        when ``out`` is None.  ``deadline`` bounds the WHOLE list read."""
+        if out is not None:
+            want = len(out)
+        elif n is not None:
+            want = int(n)
+        else:
+            raise ValueError("recv_tensors needs out= buffers or n=")
+        if want == 0:
+            return []
+        t0 = time.perf_counter() if self._obs else 0.0
+        kind, length = self._recv_frame_header(deadline)
+        if kind == ord("T"):
+            # legacy peer: first frame header is already consumed
+            res = [self._recv_tensor_body(
+                length, None if out is None else out[0], deadline, t0)]
+            for i in range(1, want):
+                res.append(self.recv_tensor(
+                    out=None if out is None else out[i], deadline=deadline))
+            return res
+        if kind != ord("P"):
+            raise ProtocolError(
+                f"expected tensor list, got kind {chr(kind)!r}")
+        return self._recv_packed_body(length, out, want, deadline, t0)
+
+    def _recv_packed_body(self, length: int, out: list | None, want: int,
+                          deadline: float | None, t0: float) -> list:
+        if length < _THDR.size:
+            self._recv_exact(length, mid_frame=True, deadline=deadline)
+            raise ProtocolError(f"packed frame too short: {length} bytes")
+        hlen = _THDR.unpack(bytes(self._recv_exact(
+            _THDR.size, mid_frame=True, deadline=deadline)))[0]
+        if _THDR.size + hlen > length:
+            raise ProtocolError(
+                f"packed manifest length {hlen} exceeds frame length "
+                f"{length}")
+        raw = bytes(self._recv_exact(hlen, mid_frame=True,
+                                     deadline=deadline))
+        data_nbytes = length - _THDR.size - hlen
+
+        def _drain_and_fail(msg):
+            # leaving the data region unread would desync the stream — the
+            # next recv would parse tensor bytes as a frame header
+            self._recv_exact(data_nbytes, mid_frame=True, deadline=deadline)
+            raise ProtocolError(msg)
+
+        try:
+            _, entries = wire.parse_manifest(raw, data_nbytes,
+                                             expect_n=want)
+        except ValueError as e:
+            _drain_and_fail(str(e))
+        if out is not None:
+            for i, (entry, o) in enumerate(zip(entries, out)):
+                if (o.dtype != np.dtype(entry["dtype"])
+                        or tuple(o.shape) != tuple(entry["shape"])):
+                    _drain_and_fail(
+                        f"recv buffer mismatch at leaf {i}: caller expects "
+                        f"{o.dtype}{tuple(o.shape)} but the manifest "
+                        f"announces {entry['dtype']}{tuple(entry['shape'])}"
+                        " — sender and receiver disagree on the tensor "
+                        "schedule (rank model/config skew)")
+        res = []
+        for i, entry in enumerate(entries):
+            dtype = np.dtype(entry["dtype"])
+            shape = tuple(entry["shape"])
+            nbytes = entry["nbytes"]
+            o = out[i] if out is not None else None
+            if entry["enc"] == "raw":
+                target = o if (o is not None and o.flags.c_contiguous
+                               and o.flags.writeable) \
+                    else np.empty(shape, dtype)
+                if nbytes:
+                    self._recv_exact(nbytes, memoryview(target).cast("B"),
+                                     mid_frame=True, deadline=deadline)
+                if o is not None and target is not o:
+                    o[...] = target
+                    target = o
+            else:
+                wbuf = np.empty(shape, wire.wire_dtype(entry))
+                if nbytes:
+                    self._recv_exact(nbytes, memoryview(wbuf).cast("B"),
+                                     mid_frame=True, deadline=deadline)
+                target = o if (o is not None and o.flags.writeable) \
+                    else np.empty(shape, dtype)
+                wire.decode_into(entry, wbuf, target)
+            res.append(target)
+        if self._obs:
+            self._h_tensor.observe(time.perf_counter() - t0)
+        return res
 
     def close(self):
         try:

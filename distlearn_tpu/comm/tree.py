@@ -200,8 +200,10 @@ class Tree:
             hdr = kid.recv_msg()
             n += int(hdr["n"])
             r += int(hdr["r"])
-            for a in acc:
-                part = kid.recv_tensor()
+            # One packed frame per child per phase (recv_tensors also
+            # accepts a legacy per-leaf stream, auto-detected).
+            parts = kid.recv_tensors(n=len(acc))
+            for a, part in zip(acc, parts):
                 if part.dtype != a.dtype:
                     # One framework, one policy: the AsyncEA server evicts
                     # on dtype skew (parallel/async_ea.py _check_delta);
@@ -216,18 +218,16 @@ class Tree:
         # Send to parent; receive final result down.
         if self._parent is not None:
             self._parent.send_msg({"n": n, "r": r})
-            for a in acc:
-                self._parent.send_tensor(a)
+            self._parent.send_tensors(acc)
             down = self._parent.recv_msg()
             total, r_total = int(down["n"]), int(down["r"])
-            final = [self._parent.recv_tensor(out=a) for a in acc]
+            final = self._parent.recv_tensors(out=acc)
         else:
             total, r_total, final = n, r, acc
         # Down phase: forward result to children.
         for kid in self._kids:
             kid.send_msg({"n": total, "r": r_total})
-            for a in final:
-                kid.send_tensor(a)
+            kid.send_tensors(final)
         treedef = _jtu.tree_structure(value)
         return _jtu.tree_unflatten(treedef, final), total, r_total
 
@@ -238,15 +238,14 @@ class Tree:
         # would silently overwrite its input (ADVICE r1).  Root copies so the
         # returned tree is detached from the caller's too.
         if self._parent is not None:
-            leaves = [self._parent.recv_tensor(
-                          out=np.empty(a.shape, a.dtype))
-                      for a in map(np.asarray, _jtu.tree_leaves(value))]
+            leaves = self._parent.recv_tensors(
+                out=[np.empty(a.shape, a.dtype)
+                     for a in map(np.asarray, _jtu.tree_leaves(value))])
         else:
             leaves = [np.array(x, copy=True, order="C")
                       for x in _jtu.tree_leaves(value)]
         for kid in self._kids:
-            for a in leaves:
-                kid.send_tensor(a)
+            kid.send_tensors(leaves)
         treedef = _jtu.tree_structure(value)
         return _jtu.tree_unflatten(treedef, leaves)
 

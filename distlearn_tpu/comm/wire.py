@@ -1,0 +1,215 @@
+"""Packed tensor-list wire codec — the coalesced frame format behind
+``Conn.send_tensors``/``recv_tensors`` (kind ``'P'`` in comm/transport.py).
+
+The reference syncs a model as one frame per pytree leaf; at 18 leaves per
+CIFAR convnet that is 18 header round-trips of kernel/syscall overhead per
+direction per sync.  A packed frame ships the whole leaf list as ONE frame:
+
+    payload := hlen:u32le | manifest[hlen] | data bytes
+    manifest = JSON {"v": 1, "codec": str, "leaves": [entry...]}
+    entry    = {"dtype": str, "shape": [int...], "enc": str,
+                "offset": int, "nbytes": int, ("scale": float)}
+
+``offset``/``nbytes`` describe each leaf's slice of the data region in
+WIRE bytes (post-encoding); ``dtype``/``shape`` are the logical tensor.
+Per-leaf ``enc`` lets one frame mix encodings: non-float leaves ride raw
+inside an fp16/int8 frame.
+
+Codecs (QSGD, Alistarh et al. 2017; 1-bit SGD, Seide et al. 2014 — the
+error-feedback residual lives in parallel/async_ea.py, client side):
+
+* ``raw``  — pass-through; zero-copy views of the caller's arrays.
+* ``fp16`` — float leaves cast to float16 (half the bytes).
+* ``int8`` — float leaves scaled per leaf by ``max|x|/127`` and rounded
+  to int8 (quarter the bytes of f32); ``scale`` rides in the manifest.
+
+Everything here is transport-agnostic and side-effect free; framing,
+metrics, and stream-alignment-on-error live in comm/transport.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+#: Codec ids a peer may request/advertise.  Order is preference order.
+CODECS = ("raw", "fp16", "int8")
+
+#: Manifest schema version (bumped on incompatible manifest changes).
+WIRE_V = 1
+
+_ENC_WIRE_DTYPE = {"fp16": np.dtype(np.float16), "int8": np.dtype(np.int8)}
+
+
+class PackedPayload:
+    """One encoded leaf list, ready for ``Conn.send_packed``.
+
+    ``bufs[i]`` is the wire-format array for ``manifest["leaves"][i]`` —
+    the original array itself for raw leaves (zero copy), a fresh
+    fp16/int8 array for encoded ones.
+    """
+
+    __slots__ = ("manifest", "bufs", "codec", "wire_nbytes", "logical_nbytes")
+
+    def __init__(self, manifest: dict, bufs: list, codec: str,
+                 wire_nbytes: int, logical_nbytes: int):
+        self.manifest = manifest
+        self.bufs = bufs
+        self.codec = codec
+        self.wire_nbytes = wire_nbytes
+        self.logical_nbytes = logical_nbytes
+
+    def decoded(self) -> list[np.ndarray]:
+        """What the receiver will reconstruct — the error-feedback residual
+        is ``sent_value - decoded()`` (raw leaves decode to themselves)."""
+        out = []
+        for entry, buf in zip(self.manifest["leaves"], self.bufs):
+            if entry["enc"] == "raw":
+                out.append(buf)
+            else:
+                dec = np.empty(tuple(entry["shape"]),
+                               np.dtype(entry["dtype"]))
+                decode_into(entry, buf, dec)
+                out.append(dec)
+        return out
+
+
+def _encode_leaf(arr: np.ndarray, codec: str) -> tuple[str, np.ndarray, dict]:
+    """Pick the per-leaf encoding: quantizers only apply to float leaves
+    wider than the wire format; everything else rides raw."""
+    if codec == "fp16" and arr.dtype.kind == "f" and arr.dtype.itemsize > 2:
+        return "fp16", arr.astype(np.float16), {}
+    if codec == "int8" and arr.dtype.kind == "f":
+        amax = float(np.max(np.abs(arr))) if arr.size else 0.0
+        if not math.isfinite(amax):
+            raise ValueError(
+                "int8 wire codec cannot encode non-finite values "
+                "(inf/nan leaf)")
+        scale = amax / 127.0
+        if scale == 0.0:
+            q = np.zeros(arr.shape, np.int8)
+        else:
+            q = np.clip(np.rint(arr / arr.dtype.type(scale)),
+                        -127, 127).astype(np.int8)
+        return "int8", q, {"scale": scale}
+    return "raw", arr, {}
+
+
+def encode_leaves(leaves, codec: str = "raw") -> PackedPayload:
+    """Encode a tensor list into one packed payload.  Raw leaves are
+    zero-copy views; the caller must not mutate them until the frame is
+    sent (the AsyncEA overlap path hands ownership to the sender)."""
+    if codec not in CODECS:
+        raise ValueError(f"unknown wire codec {codec!r} "
+                         f"(supported: {', '.join(CODECS)})")
+    entries, bufs = [], []
+    offset = logical = 0
+    for x in leaves:
+        arr = np.asarray(x)
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+        enc, buf, extra = _encode_leaf(arr, codec)
+        entry = {"dtype": arr.dtype.name, "shape": list(arr.shape),
+                 "enc": enc, "offset": offset, "nbytes": buf.nbytes}
+        entry.update(extra)
+        entries.append(entry)
+        bufs.append(buf)
+        offset += buf.nbytes
+        logical += arr.nbytes
+    manifest = {"v": WIRE_V, "codec": codec, "leaves": entries}
+    return PackedPayload(manifest, bufs, codec, offset, logical)
+
+
+def wire_dtype(entry: dict) -> np.dtype:
+    """The dtype of a leaf's bytes ON THE WIRE (its logical dtype for raw
+    leaves, the quantized dtype otherwise)."""
+    if entry["enc"] == "raw":
+        return np.dtype(entry["dtype"])
+    return _ENC_WIRE_DTYPE[entry["enc"]]
+
+
+def decode_into(entry: dict, wirebuf: np.ndarray, out: np.ndarray) -> None:
+    """Dequantize one encoded leaf into a preallocated logical-dtype
+    buffer (raw leaves never come through here — the transport reads them
+    straight into the target)."""
+    enc = entry["enc"]
+    if enc == "fp16":
+        out[...] = wirebuf
+    elif enc == "int8":
+        np.multiply(wirebuf, out.dtype.type(entry["scale"]), out=out)
+    else:
+        raise ValueError(f"decode_into on {enc!r} leaf")
+
+
+def parse_manifest(raw: bytes, data_nbytes: int,
+                   expect_n: int | None = None) -> tuple[str, list[dict]]:
+    """Validate a received manifest against the frame's data-region size.
+
+    Raises ``ValueError`` on ANY structural problem — wrong JSON, unknown
+    codec/encoding, negative/overflowing shapes, offsets that do not tile
+    the data region, leaf count mismatch.  The transport converts that to
+    ``ProtocolError`` after draining the announced payload, so a corrupt
+    manifest never desyncs the stream.
+    """
+    import json
+    try:
+        doc = json.loads(raw)
+    except ValueError as e:
+        raise ValueError(f"undecodable packed manifest: {e}") from None
+    if not isinstance(doc, dict) or not isinstance(doc.get("leaves"), list):
+        raise ValueError("packed manifest is not {codec, leaves} shaped")
+    codec = doc.get("codec")
+    if codec not in CODECS:
+        raise ValueError(f"unknown wire codec {codec!r} in manifest")
+    entries = doc["leaves"]
+    if expect_n is not None and len(entries) != expect_n:
+        raise ValueError(
+            f"packed frame carries {len(entries)} leaves, receiver "
+            f"expects {expect_n} — sender and receiver disagree on the "
+            "tensor schedule")
+    offset = 0
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise ValueError(f"leaf {i}: manifest entry is not an object")
+        try:
+            dtype = np.dtype(entry["dtype"])
+            shape = tuple(int(s) for s in entry["shape"])
+            enc = entry["enc"]
+            nbytes = int(entry["nbytes"])
+            off = int(entry["offset"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"leaf {i}: bad manifest entry: {e}") from None
+        if any(s < 0 for s in shape):
+            raise ValueError(f"leaf {i}: negative dimension in {shape}")
+        if enc not in ("raw",) + tuple(_ENC_WIRE_DTYPE):
+            raise ValueError(f"leaf {i}: unknown encoding {enc!r}")
+        if enc != "raw" and dtype.kind != "f":
+            raise ValueError(
+                f"leaf {i}: {enc} encoding on non-float dtype {dtype}")
+        if enc == "int8":
+            try:
+                scale = float(entry["scale"])
+            except (KeyError, TypeError, ValueError):
+                raise ValueError(f"leaf {i}: int8 leaf missing scale") \
+                    from None
+            if not math.isfinite(scale):
+                raise ValueError(f"leaf {i}: non-finite int8 scale {scale}")
+        wdt = np.dtype(dtype) if enc == "raw" else _ENC_WIRE_DTYPE[enc]
+        # Python-int product: immune to C-long overflow from a hostile
+        # header (same hardening as recv_tensor).
+        expect = math.prod(shape) * wdt.itemsize
+        if nbytes != expect:
+            raise ValueError(
+                f"leaf {i}: wire payload {nbytes} bytes != {expect} "
+                f"expected for {enc}-encoded {dtype}{shape}")
+        if off != offset:
+            raise ValueError(
+                f"leaf {i}: offset {off} does not tile the data region "
+                f"(expected {offset})")
+        offset += nbytes
+    if offset != data_nbytes:
+        raise ValueError(
+            f"manifest leaves cover {offset} bytes but the frame carries "
+            f"{data_nbytes}")
+    return codec, entries

@@ -104,15 +104,33 @@ def ring_allreduce_schedule(num_nodes: int) -> dict:
     return sched
 
 
-def async_ea_sync_schedule(num_leaves: int = 2, *, client_order=None) -> dict:
+def async_ea_sync_schedule(num_leaves: int = 2, *, client_order=None,
+                           packed: bool = False) -> dict:
     """One AsyncEA sync round between the serial server ``S`` and one
     client ``C`` (``AsyncEAServer.sync_server`` / ``AsyncEAClient.sync``).
 
     ``client_order`` overrides the client's question order — the linter's
     known-bad configuration swaps ``Center?``/``delta?`` to demonstrate the
     DL104 desync such an edit would introduce.
+
+    ``packed=True`` models the negotiated coalesced wire (frame kind
+    ``'P'``, comm/wire.py): the per-leaf ``center``/``delta_t`` legs
+    collapse into ONE ``center_p`` / ``delta_p`` frame each way, so the
+    simulator keeps covering both framings of the handshake.
     """
     L = num_leaves
+    if packed:
+        # Enter carries the wire ack; each tensor stream is one 'P' frame.
+        server = [recv_any("Enter?"), send("C", "Enter"),
+                  recv("C", "Center?"), send("C", "center_p"),
+                  recv("C", "delta?"), send("C", "delta"),
+                  recv("C", "delta_p")]
+        order = client_order or ("Center?", "delta?")
+        client = [send("S", "Enter?"), recv("S", "Enter"),
+                  send("S", order[0]), recv("S", "center_p"),
+                  send("S", order[1]), recv("S", "delta"),
+                  send("S", "delta_p")]
+        return {"S": server, "C": client}
     server = ([recv_any("Enter?"), send("C", "Enter"), recv("C", "Center?")]
               + [send("C", "center")] * L
               + [recv("C", "delta?"), send("C", "delta")]
@@ -227,6 +245,7 @@ def _find_cycle(waits: Mapping):
 #: get_nowait style accessors are deliberately excluded.
 _BLOCKING_CALLS = frozenset({
     "recv_msg", "recv_tensor", "send_msg", "send_tensor",
+    "send_tensors", "recv_tensors", "send_packed",
     "accept", "recv_any", "select", "connect",
 })
 
@@ -369,6 +388,8 @@ def lint_comm_protocols(*, num_nodes: int = 7) -> list[Finding]:
                                 name="ring.all_reduce")
     findings += check_schedules(async_ea_sync_schedule(),
                                 name="async_ea.sync")
+    findings += check_schedules(async_ea_sync_schedule(packed=True),
+                                name="async_ea.sync-packed")
     from distlearn_tpu.comm import ring, transport, tree
     from distlearn_tpu.parallel import async_ea
     findings += lock_order_audit([transport, tree, ring, async_ea],

@@ -39,7 +39,7 @@ import jax
 import numpy as np
 
 from distlearn_tpu import obs
-from distlearn_tpu.comm import Conn, ProtocolError, Server, connect
+from distlearn_tpu.comm import Conn, ProtocolError, Server, connect, wire
 from distlearn_tpu.utils.logging import print_client, print_server, print_tester
 
 PyTree = Any
@@ -53,6 +53,56 @@ DELTA_Q = "delta?"
 DELTA = "delta"
 TEST_Q = "Test?"
 ACK = "Ack"
+
+# ---------------------------------------------------------------------------
+# Wire negotiation (packed 'P' frames + codecs, comm/wire.py).
+#
+# A new client advertises {"wire": {"v": 1, "codec": ...}} inside its
+# Enter?/Rejoin? request; extra keys are invisible to an old server (it only
+# reads "q"/"clientID" and replies the plain "Enter" string), so the client
+# detects a legacy peer from the STRING reply and falls back to per-leaf
+# 'T' frames.  A new server replies {"a": "Enter", "wire": {...}} — a dict
+# — ONLY to clients that advertised, so old clients keep getting the plain
+# string they expect.  Both directions of a negotiated handshake (center
+# down, delta up) then use ONE packed frame with the agreed codec.  An
+# unsupported codec is answered with a wire error and an eviction — mixed
+# fleets fail loudly (ProtocolError at the client) instead of silently
+# corrupting tensors.
+
+
+def _parse_wire_request(msg) -> tuple[str | None, str | None]:
+    """(codec, error) from an admission-family message's "wire" key.
+    ``(None, None)`` = legacy peer; ``(codec, None)`` = negotiated;
+    ``(codec, error)`` = advertised but unusable (answer loudly)."""
+    spec = msg.get("wire") if isinstance(msg, dict) else None
+    if spec is None:
+        return None, None
+    if not isinstance(spec, dict):
+        return None, f"malformed wire spec {spec!r}"
+    codec = spec.get("codec")
+    if codec not in wire.CODECS:
+        return codec, (f"unsupported wire codec {codec!r} "
+                       f"(supported: {', '.join(wire.CODECS)})")
+    return codec, None
+
+
+def _check_wire_reply(reply, want: str, codec: str) -> bool:
+    """Client-side half of the negotiation: True when the server agreed to
+    the packed wire, False when it answered with the legacy plain string
+    (fall back to per-leaf frames), ProtocolError on desync or rejection."""
+    if reply == want:
+        return False                      # legacy server: per-leaf 'T' wire
+    if isinstance(reply, dict) and reply.get("a") == want:
+        w = reply.get("wire")
+        if isinstance(w, dict) and w.get("error"):
+            raise ProtocolError(
+                f"server rejected wire codec {codec!r}: {w['error']}")
+        if not isinstance(w, dict) or w.get("codec") != codec:
+            raise ProtocolError(
+                f"wire negotiation desync: requested codec {codec!r}, "
+                f"server answered {w!r}")
+        return True
+    raise ProtocolError(f"protocol desync: expected {want!r}, got {reply!r}")
 
 
 def _leaves(tree: PyTree) -> list[np.ndarray]:
@@ -87,6 +137,9 @@ class AsyncEAServer:
         self.handshake_timeout = handshake_timeout
         self.evicted: set[int] = set()
         self._cid_to_broadcast: dict[int, int] = {}
+        # negotiated wire codec per client id (None = legacy per-leaf 'T'
+        # frames), refreshed on every Enter?/Rejoin? — see _admit
+        self._wire_cid: dict[int, str | None] = {}
         # broadcast conns accepted for a possible rejoin that have not yet
         # spoken, with a speak-by deadline — a dialed-but-silent socket
         # must not keep the serve/dispatch loop alive forever
@@ -130,8 +183,11 @@ class AsyncEAServer:
         self.center = [x.copy() for x in _leaves(params)]
         for conn in self.broadcast.conns:
             try:
-                for t in self.center:
-                    conn.send_tensor(t)
+                # per-leaf 'T' frames: the initial broadcast happens BEFORE
+                # any client has spoken, so there is no capability
+                # advertisement to negotiate against — old-wire clients
+                # must be able to read it (new clients auto-detect either)
+                conn.send_tensors(self.center, packed=False)
             except (TimeoutError, ConnectionError, OSError) as e:
                 # Dead before the first broadcast: drop it; it is evicted for
                 # real when it never completes a handshake.
@@ -292,6 +348,7 @@ class AsyncEAServer:
             self._drop_peer(idx, f"dropping rejoin with bad clientID "
                                  f"{msg.get('clientID')!r}")
             return
+        codec, wire_err = _parse_wire_request(msg)
         try:
             # SHORT bound: the rejoin protocol dials the dedicated channel
             # BEFORE announcing Rejoin?, so a legit dial is already in the
@@ -311,9 +368,18 @@ class AsyncEAServer:
         try:
             with obs.span("async_ea.rejoin", cid=cid):
                 new.set_timeout(self.handshake_timeout)
-                new.send_msg(REJOIN)
-                for t in self._rejoin_center():
-                    new.send_tensor(t)
+                if wire_err is not None:
+                    # same loud rejection as _reject_wire, on the rejoin leg
+                    new.send_msg({"a": REJOIN, "wire": {"error": wire_err}})
+                    raise ProtocolError(wire_err)
+                self._wire_cid[cid] = codec
+                if codec is not None:
+                    new.send_msg({"a": REJOIN,
+                                  "wire": {"v": wire.WIRE_V, "codec": codec}})
+                else:
+                    new.send_msg(REJOIN)
+                new.send_tensors(self._rejoin_center(),
+                                 codec=codec or "raw", packed=codec is not None)
                 _expect(new, ACK)
                 new.set_timeout(None)
         except (TimeoutError, ConnectionError, ProtocolError, OSError,
@@ -361,7 +427,26 @@ class AsyncEAServer:
                                  f"{msg.get('clientID')!r}")
             return None
         self._cid_to_broadcast[cid] = idx
+        codec, wire_err = _parse_wire_request(msg)
+        if wire_err is not None:
+            self._reject_wire(cid, wire_err)
+            return None
+        self._wire_cid[cid] = codec
         return cid
+
+    def _reject_wire(self, cid: int, err: str):
+        """A client advertised a wire codec this server cannot speak:
+        answer LOUDLY on the dedicated channel (where the client blocks
+        waiting for Enter — it raises ProtocolError on the error reply)
+        and evict.  Silently falling back would ship fp32 to a client
+        that asked for compression; silently proceeding would corrupt."""
+        conn = self.dedicated[cid - 1]
+        try:
+            conn.set_timeout(self.handshake_timeout)
+            conn.send_msg({"a": ENTER, "wire": {"error": err}})
+        except (TimeoutError, ConnectionError, OSError):
+            pass
+        self._evict(cid, ProtocolError(err))
 
     def sync_server(self, params: PyTree,
                     timeout: float | None = None) -> PyTree:
@@ -413,16 +498,23 @@ class AsyncEAServer:
             self.current_client = cid
             conn = self.dedicated[cid - 1]  # 1-based ids (ref)
             t0 = time.perf_counter() if self._obs_on else 0.0
+            codec = self._wire_cid.get(cid)
             try:
                 with obs.span("async_ea.handshake", cid=cid):
                     conn.set_timeout(self.handshake_timeout)
-                    conn.send_msg(ENTER)
+                    if codec is not None:
+                        conn.send_msg({"a": ENTER,
+                                       "wire": {"v": wire.WIRE_V,
+                                                "codec": codec}})
+                    else:
+                        conn.send_msg(ENTER)
                     print_server(f"current client is #{self.current_client}")
 
-                    # serverSendCenter (lua :180-196)
+                    # serverSendCenter (lua :180-196): ONE packed frame on
+                    # a negotiated wire, per-leaf 'T' frames for legacy
                     _expect(conn, CENTER_Q)
-                    for t in self.center:
-                        conn.send_tensor(t)
+                    conn.send_tensors(self.center, codec=codec or "raw",
+                                      packed=codec is not None)
 
                     # serverGetUpdateDiff (lua :198-228): receive the FULL
                     # delta before applying any of it, so an eviction
@@ -435,8 +527,11 @@ class AsyncEAServer:
                     conn.send_msg(DELTA)
                     dl = (None if self.handshake_timeout is None
                           else time.monotonic() + self.handshake_timeout)
-                    deltas = [conn.recv_tensor(deadline=dl)
-                              for _ in self.center]
+                    # auto-detects packed vs per-leaf, so a legacy client
+                    # needs no branch here; quantized deltas decode into
+                    # fresh center-dtype arrays
+                    deltas = conn.recv_tensors(n=len(self.center),
+                                               deadline=dl)
                     self._check_delta(deltas)
                     conn.set_timeout(None)
             except (TimeoutError, ConnectionError, ProtocolError, OSError,
@@ -462,9 +557,20 @@ class AsyncEAServer:
         try:
             conn.set_timeout(self.handshake_timeout)
             conn.send_msg(TEST_Q)
-            _expect(conn, CENTER_Q)
-            for t in (tensors if tensors is not None else self.center):
-                conn.send_tensor(t)
+            # the tester's Center? may carry a wire advertisement (a dict,
+            # like Enter?) — negotiate the packed frame the same way
+            msg = conn.recv_msg()
+            codec = None
+            if isinstance(msg, dict) and msg.get("q") == CENTER_Q:
+                codec, wire_err = _parse_wire_request(msg)
+                if wire_err is not None:
+                    conn.send_msg({"a": TEST_Q, "wire": {"error": wire_err}})
+                    raise ProtocolError(wire_err)
+            elif msg != CENTER_Q:
+                raise ProtocolError(
+                    f"protocol desync: expected {CENTER_Q!r}, got {msg!r}")
+            conn.send_tensors(tensors if tensors is not None else self.center,
+                              codec=codec or "raw", packed=codec is not None)
             _expect(conn, ACK)
             conn.set_timeout(None)
             return True
@@ -798,15 +904,24 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             # this thread is parked on the queue (dispatcher-side
             # evictions never unpark it)
             conn = self.dedicated[cid - 1]
+            codec = self._wire_cid.get(cid)
             t0 = time.perf_counter() if self._obs_on else 0.0
             try:
                 try:
                     with obs.span("async_ea.handshake", cid=cid):
                         conn.set_timeout(self.handshake_timeout)
-                        conn.send_msg(ENTER)
+                        if codec is not None:
+                            conn.send_msg({"a": ENTER,
+                                           "wire": {"v": wire.WIRE_V,
+                                                    "codec": codec}})
+                        else:
+                            conn.send_msg(ENTER)
                         _expect(conn, CENTER_Q)
-                        for t in self._snapshot():  # stream OUTSIDE the lock
-                            conn.send_tensor(t)
+                        # stream OUTSIDE the lock; one packed frame on a
+                        # negotiated wire
+                        conn.send_tensors(self._snapshot(),
+                                          codec=codec or "raw",
+                                          packed=codec is not None)
                         _expect(conn, DELTA_Q)
                         conn.send_msg(DELTA)
                         # whole-delta-stream deadline: see sync_server
@@ -816,13 +931,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                             if bufs is None:
                                 bufs = [np.empty_like(t)
                                         for t in self.center]
-                            # recv_tensor(out=...) itself rejects shape/dtype
-                            # skew (ValueError -> eviction below)
-                            deltas = [conn.recv_tensor(out=b, deadline=dl)
-                                      for b in bufs]
+                            # recv_tensors(out=...) itself rejects shape/
+                            # dtype skew (ProtocolError -> eviction below)
+                            # and auto-detects packed vs per-leaf frames
+                            deltas = conn.recv_tensors(out=bufs, deadline=dl)
                         else:
-                            deltas = [conn.recv_tensor(deadline=dl)
-                                      for _ in self.center]
+                            deltas = conn.recv_tensors(n=len(self.center),
+                                                       deadline=dl)
                         self._check_delta(deltas)   # before ANY apply: a
                         # config-skewed client is an eviction, never a torn
                         # or silently-dead worker (the serve loop polls
@@ -856,15 +971,98 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     self._g_inflight.dec()
 
 
-class AsyncEAClient:
-    """Worker role (ref initClient/syncClient)."""
+class _DeltaSender:
+    """Depth-1 background sender for the compute/communication overlap
+    path: ``submit(job)`` hands the previous round's delta transmit to a
+    worker thread and returns immediately, so the next round's τ local
+    steps overlap the delta's wire round-trip.  The bounded queue (at most
+    ONE in-flight job — ``submit`` flushes the previous one first)
+    preserves the EASGD staleness bound: a client can never be more than
+    one un-acknowledged delta ahead of the center it last fetched.
 
-    def __init__(self, host: str, port: int, node: int, tau: int, alpha: float):
+    A background failure is stored and re-raised at the next ``flush``
+    (the top of the next sync), where the caller's eviction/rejoin
+    handling already lives; ``drain`` discards it (the rejoin path is
+    about to replace the connection the error came from)."""
+
+    def __init__(self):
+        import queue
+        import threading
+        self._q: Any = queue.Queue(maxsize=1)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._err: BaseException | None = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._idle.set()
+                return
+            try:
+                job()
+            except BaseException as e:  # noqa: BLE001 — surfaced at flush
+                self._err = e
+            finally:
+                self._idle.set()
+
+    def flush(self):
+        """Wait out the in-flight job; re-raise its failure, if any."""
+        self._idle.wait()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    def submit(self, job):
+        self.flush()            # depth 1: at most one delta in flight
+        self._idle.clear()
+        self._q.put(job)
+
+    def drain(self):
+        """Wait for idle and DISCARD any stored failure (eviction/rejoin
+        cleanup — the conn the failure came from is being replaced)."""
+        self._idle.wait()
+        self._err = None
+
+    def close(self):
+        self._idle.wait()
+        self._q.put(None)
+        self._t.join(timeout=5.0)
+        self._err = None
+
+
+class AsyncEAClient:
+    """Worker role (ref initClient/syncClient).
+
+    ``codec`` selects the wire format for the sync handshake: ``"raw"``
+    (default) coalesces each direction into one packed frame, ``"fp16"``/
+    ``"int8"`` additionally quantize (deltas carry client-side
+    error-feedback residuals so the quantization error is re-injected
+    into later rounds, 1-bit-SGD style); ``None`` speaks the legacy
+    per-leaf wire unconditionally.  The codec is negotiated per handshake
+    — against an old server the client silently falls back to the legacy
+    frames (the server never sees the advertisement's extra keys).
+
+    ``overlap=True`` pushes each round's delta from a background sender
+    (depth-1 queue) so local training overlaps the transmit round-trip;
+    failures surface at the NEXT sync, where eviction handling already
+    lives.
+    """
+
+    def __init__(self, host: str, port: int, node: int, tau: int,
+                 alpha: float, codec: str | None = "raw",
+                 overlap: bool = False):
         if node < 1:
             raise ValueError("node is 1-based (reference convention)")
+        if codec is not None and codec not in wire.CODECS:
+            raise ValueError(f"unknown wire codec {codec!r} "
+                             f"(supported: {', '.join(wire.CODECS)})")
         self.node = node
         self.tau = int(tau)
         self.alpha = float(alpha)
+        self.codec = codec
         self.step = 0
         self.host, self.port = host, port
         # clientBroadcast -> port; dedicated client -> port+node
@@ -872,12 +1070,37 @@ class AsyncEAClient:
         self.broadcast = connect(host, port)
         self.conn = connect(host, port + node)
         self.center: list[np.ndarray] | None = None
+        # None until the first handshake; False pins legacy once a plain-
+        # string reply proves the server predates the packed wire
+        self._packed: bool | None = None
+        self._residuals: list[np.ndarray] | None = None
+        self._sender = _DeltaSender() if overlap else None
+
+    def _announce(self, q: str, want: str) -> bool:
+        """Send an admission request (with the wire advertisement unless a
+        previous reply proved the server legacy) and parse the reply.
+        Returns True when this handshake uses the packed wire."""
+        adv = self.codec is not None and self._packed is not False
+        msg: dict[str, Any] = {"q": q, "clientID": self.node}
+        if adv:
+            msg["wire"] = {"v": wire.WIRE_V, "codec": self.codec}
+        self.broadcast.send_msg(msg)
+        reply = self.conn.recv_msg()
+        if not adv:
+            if reply != want:
+                raise ProtocolError(
+                    f"protocol desync: expected {want!r}, got {reply!r}")
+            return False
+        self._packed = _check_wire_reply(reply, want, self.codec)
+        return self._packed
 
     def init_client(self, params: PyTree) -> PyTree:
         """Receive the initial center from the server's broadcast; params :=
-        center (ref lua :64-78)."""
+        center (ref lua :64-78).  The initial broadcast is always per-leaf
+        (nothing has been negotiated yet) but ``recv_tensors`` auto-detects
+        either framing."""
         leaves = _leaves(params)
-        self.center = [self.broadcast.recv_tensor() for _ in leaves]
+        self.center = self.broadcast.recv_tensors(n=len(leaves))
         return _rebuild(params, [c.copy() for c in self.center])
 
     def sync_client(self, params: PyTree) -> tuple[PyTree, bool]:
@@ -887,13 +1110,18 @@ class AsyncEAClient:
         if self.step % self.tau != 0:   # isSyncNeeded (lua :47-57)
             return params, False
 
+        if self._sender is not None:
+            # previous round's delta must be fully on the wire before the
+            # next Enter? — also where a background failure surfaces
+            self._sender.flush()
         # clientEnterSync (lua :82-92)
         print_client(self.node, "waiting to sync")
-        self.broadcast.send_msg({"q": ENTER_Q, "clientID": self.node})
-        _expect(self.conn, ENTER)
-        # clientGetCenter (lua :95-106)
+        packed = self._announce(ENTER_Q, ENTER)
+        # clientGetCenter (lua :95-106): one packed frame (negotiated) or
+        # per-leaf, auto-detected — either way into the preallocated
+        # center buffers
         self.conn.send_msg(CENTER_Q)
-        self.center = [self.conn.recv_tensor(out=c) for c in self.center]
+        self.center = self.conn.recv_tensors(out=self.center)
         # calculateUpdateDiff (lua :109-119): local EA math.  The scale is
         # folded in-place into the one (p - c) temporary — at 100 MB-leaf
         # scale a second full-size allocation per leaf is measurable on the
@@ -909,11 +1137,42 @@ class AsyncEAClient:
             d *= np.asarray(self.alpha, d.dtype)
             deltas.append(d)
         new_leaves = [p - d for p, d in zip(leaves, deltas)]
+        payload = None
+        if packed:
+            if self.codec != "raw":
+                # error feedback (Seide et al. 2014): quantize delta +
+                # carried residual, keep the quantization error for the
+                # next round — without it the bias accumulates and
+                # quantized-EA walks away from the fp32 fixed point
+                if (self._residuals is None
+                        or len(self._residuals) != len(deltas)):
+                    self._residuals = [np.zeros_like(d) for d in deltas]
+                for d, r in zip(deltas, self._residuals):
+                    d += r
+                payload = wire.encode_leaves(deltas, self.codec)
+                for r, d, dec in zip(self._residuals, deltas,
+                                     payload.decoded()):
+                    np.subtract(d, dec, out=r)
+            else:
+                payload = wire.encode_leaves(deltas, "raw")
         # clientSendDiff (lua :122-132)
-        self.conn.send_msg(DELTA_Q)
-        _expect(self.conn, DELTA)
-        for d in deltas:
-            self.conn.send_tensor(d)
+        conn = self.conn
+
+        def _push_delta():
+            conn.send_msg(DELTA_Q)
+            _expect(conn, DELTA)
+            if payload is not None:
+                conn.send_packed(payload)
+            else:
+                for d in deltas:
+                    conn.send_tensor(d)
+
+        if self._sender is not None:
+            # overlap: the transmit/apply round-trip runs behind the next
+            # τ local steps; params for those steps are already computed
+            self._sender.submit(_push_delta)
+        else:
+            _push_delta()
         print_client(self.node, "synced")
         return _rebuild(params, new_leaves), True
 
@@ -930,6 +1189,13 @@ class AsyncEAClient:
         error if the server is gone; safe to call again.  Local state
         (``step``, ``tau``) is preserved so the sync cadence continues.
         """
+        if self._sender is not None:
+            # wait out (and discard the failure of) any in-flight delta —
+            # it was riding the connection being replaced
+            self._sender.drain()
+        # the center we quantized against is gone; carrying a residual
+        # across an eviction would re-inject error from a stale round
+        self._residuals = None
         for c in (self.broadcast, self.conn):
             try:
                 c.close()
@@ -941,32 +1207,44 @@ class AsyncEAClient:
                                  retry_interval=retry_interval)
         self.conn = connect(self.host, self.port + self.node,
                             retries=retries, retry_interval=retry_interval)
-        self.broadcast.send_msg({"q": REJOIN_Q, "clientID": self.node})
         # bounded: a server that never re-admits (e.g. this client was
         # transport-dropped without an eviction record) must surface a
         # TimeoutError here, not wedge the worker forever
         self.conn.set_timeout(handshake_timeout)
-        _expect(self.conn, REJOIN)
+        self._announce(REJOIN_Q, REJOIN)
         leaves = _leaves(params)
         # deadline over the WHOLE center stream: a server stalling
         # mid-tensor must surface here too, not only on control frames
         dl = (None if handshake_timeout is None
               else time.monotonic() + handshake_timeout)
-        self.center = [self.conn.recv_tensor(deadline=dl) for _ in leaves]
+        self.center = self.conn.recv_tensors(n=len(leaves), deadline=dl)
         self.conn.send_msg(ACK)
         self.conn.set_timeout(None)
         print_client(self.node, "re-admitted")
         return _rebuild(params, [c.copy() for c in self.center])
 
     def close(self):
+        if self._sender is not None:
+            self._sender.close()
         self.broadcast.close()
         self.conn.close()
 
 
 class AsyncEATester:
-    """Evaluation role (ref initTester/startTest/finishTest)."""
+    """Evaluation role (ref initTester/startTest/finishTest).
 
-    def __init__(self, host: str, port: int, num_nodes: int):
+    ``codec`` opts into the packed wire for center fetches.  Unlike the
+    client, the tester's advertisement rides its OWN ``Center?`` request
+    (there is no prior Enter? leg), so an advertising tester against an
+    old server desyncs — leave ``codec=None`` in mixed fleets.
+    """
+
+    def __init__(self, host: str, port: int, num_nodes: int,
+                 codec: str | None = None):
+        if codec is not None and codec not in wire.CODECS:
+            raise ValueError(f"unknown wire codec {codec!r} "
+                             f"(supported: {', '.join(wire.CODECS)})")
+        self.codec = codec
         # test channel on port+numNodes+1 (EASGD_tester.lua:64)
         self.conn = connect(host, port + num_nodes + 1)
 
@@ -974,9 +1252,14 @@ class AsyncEATester:
         """Block until the server pushes ``Test?``; fetch center into params
         (ref lua :268-285)."""
         _expect(self.conn, TEST_Q)
-        self.conn.send_msg(CENTER_Q)
+        if self.codec is not None:
+            self.conn.send_msg({"q": CENTER_Q,
+                                "wire": {"v": wire.WIRE_V,
+                                         "codec": self.codec}})
+        else:
+            self.conn.send_msg(CENTER_Q)
         leaves = _leaves(params)
-        new = [self.conn.recv_tensor() for _ in leaves]
+        new = self.conn.recv_tensors(n=len(leaves))
         print_tester("received center for evaluation")
         return _rebuild(params, new)
 

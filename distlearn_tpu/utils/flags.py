@@ -90,6 +90,11 @@ ASYNC_FLAGS = {
     "verbose": (False, "protocol logging (colorPrint parity)"),
     "testTime": (10, "server-side syncs between test pushes"),
     "save": ("", "checkpoint directory (empty = no checkpointing)"),
+    "wireCodec": ("raw", "sync wire codec: raw (packed fp32), fp16, int8 "
+                         "(quantized deltas with error feedback), or "
+                         "legacy (per-leaf frames, pre-packed peers)"),
+    "overlapSync": (False, "overlap local steps with the delta transmit "
+                           "(background sender, depth-1 queue)"),
 }
 
 OBS_FLAGS = {

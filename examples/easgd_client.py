@@ -44,8 +44,10 @@ def main():
     model, params, mstate, ds, nc = build_model_and_data(
         opt, partition=opt.nodeIndex - 1, partitions=opt.numNodes)
 
+    codec = None if opt.wireCodec == "legacy" else opt.wireCodec
     client = AsyncEAClient(opt.host, opt.port, node=opt.nodeIndex,
-                           tau=opt.communicationTime, alpha=opt.alpha)
+                           tau=opt.communicationTime, alpha=opt.alpha,
+                           codec=codec, overlap=opt.overlapSync)
     params = client.init_client(params)
 
     @jax.jit

@@ -59,7 +59,10 @@ def main():
             np.add.at(cm, (by, preds), 1)
         return 1.0 - M.total_valid(cm)
 
-    tester = AsyncEATester(opt.host, opt.port, opt.numNodes)
+    # tester advertisement only works against a same-version server —
+    # "legacy" (or raw against old fleets) keeps the pre-packed wire
+    codec = None if opt.wireCodec in ("legacy", "raw") else opt.wireCodec
+    tester = AsyncEATester(opt.host, opt.port, opt.numNodes, codec=codec)
     for round_i in range(1, opt.numTests + 1):
         params = tester.start_test(params)   # blocks for server push
         train_err = error_rate(params, mstate, ds)

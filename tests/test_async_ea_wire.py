@@ -1,0 +1,471 @@
+"""Wire-codec negotiation and byte-reduction tests for the AsyncEA
+protocol: packed/quantized sync handshakes, mixed-version fleets
+(old client / old server emulation), error-feedback convergence parity,
+the compute/communication overlap sender, and the obs-verified e2e
+byte-reduction acceptance criterion (ISSUE 4).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlearn_tpu import obs
+from distlearn_tpu.comm import ProtocolError, Server, wire
+from distlearn_tpu.parallel.async_ea import (ACK, CENTER_Q, DELTA, DELTA_Q,
+                                             ENTER, ENTER_Q, REJOIN,
+                                             AsyncEAClient, AsyncEAServer,
+                                             AsyncEATester,
+                                             _check_wire_reply,
+                                             _parse_wire_request)
+from distlearn_tpu.utils.logging import set_verbose
+
+set_verbose(False)
+
+from tests.net_util import reserve_port_window
+
+pytestmark = pytest.mark.comm_perf
+
+
+def _ports(n: int = 8) -> int:
+    return reserve_port_window(n)
+
+
+def _params():
+    return {"w": np.zeros((4, 3), np.float32), "b": np.zeros((3,), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Negotiation unit behavior (the handshake legs, no sockets).
+
+def test_parse_wire_request_variants():
+    assert _parse_wire_request("Enter?") == (None, None)
+    assert _parse_wire_request({"q": ENTER_Q, "clientID": 1}) == (None, None)
+    codec, err = _parse_wire_request(
+        {"q": ENTER_Q, "wire": {"v": 1, "codec": "int8"}})
+    assert codec == "int8" and err is None
+    codec, err = _parse_wire_request(
+        {"q": ENTER_Q, "wire": {"v": 1, "codec": "zstd"}})
+    assert codec == "zstd" and "unsupported" in err
+    _, err = _parse_wire_request({"q": ENTER_Q, "wire": "bogus"})
+    assert err is not None
+
+
+def test_check_wire_reply_variants():
+    # legacy plain-string reply -> fall back to per-leaf frames
+    assert _check_wire_reply(ENTER, ENTER, "raw") is False
+    # negotiated dict reply -> packed
+    assert _check_wire_reply(
+        {"a": ENTER, "wire": {"v": wire.WIRE_V, "codec": "int8"}},
+        ENTER, "int8") is True
+    # server-side rejection must be LOUD, not a silent downgrade
+    with pytest.raises(ProtocolError, match="rejected"):
+        _check_wire_reply({"a": ENTER, "wire": {"error": "unsupported"}},
+                          ENTER, "int8")
+    with pytest.raises(ProtocolError, match="desync"):
+        _check_wire_reply({"a": ENTER, "wire": {"codec": "fp16"}},
+                          ENTER, "int8")
+    with pytest.raises(ProtocolError):
+        _check_wire_reply("delta", ENTER, "raw")
+
+
+def test_client_rejects_unknown_codec_at_construction():
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        AsyncEAClient("127.0.0.1", 1, node=1, tau=1, alpha=0.5,
+                      codec="zstd")
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        AsyncEATester("127.0.0.1", 1, 1, codec="zstd")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end negotiated syncs.
+
+def _one_sync(port, codec, drift=2.0, overlap=False):
+    """One client, one tau=1 sync against a serial server; returns
+    (client_params, server_params, client)."""
+    out = {}
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                          codec=codec, overlap=overlap)
+        p = c.init_client(_params())
+        p = {"w": p["w"] + drift, "b": p["b"] + 2 * drift}
+        p, synced = c.sync_client(p)
+        assert synced
+        out["p"] = p
+        out["packed"] = c._packed
+        c.close()
+
+    th = threading.Thread(target=client_fn)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1)
+    srv.init_server(_params())
+    new_params = srv.sync_server(_params())
+    th.join(timeout=30)
+    srv.close()
+    return out, new_params
+
+
+@pytest.mark.parametrize("codec,packed", [("raw", True), (None, None)])
+def test_sync_math_exact_per_codec(codec, packed):
+    """raw-packed and legacy-per-leaf syncs produce bit-identical EASGD
+    math (delta=(p-c)*alpha both ways)."""
+    out, new_params = _one_sync(_ports(), codec)
+    assert out["packed"] is packed
+    np.testing.assert_allclose(out["p"]["w"], 1.0)
+    np.testing.assert_allclose(out["p"]["b"], 2.0)
+    np.testing.assert_allclose(new_params["w"], 1.0)
+    np.testing.assert_allclose(new_params["b"], 2.0)
+
+
+def test_int8_sync_within_quantization_tolerance():
+    out, new_params = _one_sync(_ports(), "int8")
+    assert out["packed"] is True
+    # delta=1.0 quantized with scale=max|d|/127: error <= scale/2
+    np.testing.assert_allclose(new_params["w"], 1.0, atol=0.02)
+    np.testing.assert_allclose(new_params["b"], 2.0, atol=0.04)
+
+
+def test_overlap_sync_math_unchanged():
+    """The background sender must not change the EASGD math — flush at the
+    next sync (or close) serializes the delta before any new handshake."""
+    out, new_params = _one_sync(_ports(), "raw", overlap=True)
+    np.testing.assert_allclose(out["p"]["w"], 1.0)
+    np.testing.assert_allclose(new_params["w"], 1.0)
+
+
+def test_overlap_multi_round_accumulation():
+    """τ-overlapped rounds: every delta lands exactly once (the depth-1
+    queue preserves the round-serial protocol on the wire)."""
+    port = _ports()
+    rounds = 6
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                          overlap=True)
+        p = c.init_client({"w": np.zeros((2, 2), np.float32)})
+        for _ in range(rounds):
+            p = {"w": p["w"] + 1.0}
+            p, synced = c.sync_client(p)
+            assert synced
+        c.close()
+
+    th = threading.Thread(target=client_fn)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1)
+    srv.init_server({"w": np.zeros((2, 2), np.float32)})
+    for _ in range(rounds):
+        srv.sync_server({"w": np.zeros((2, 2), np.float32)})
+    th.join(timeout=30)
+    center = srv.center[0].copy()
+    srv.close()
+    # tau=1, alpha=.5, drift +1/round: closed-form fixed-point walk —
+    # center_n and params converge toward drift*(alpha weights); exactness
+    # matters less than EVERY delta landing exactly once: compare against
+    # the same loop run serially (no overlap) below.
+    port2 = _ports()
+
+    def client2_fn():
+        c = AsyncEAClient("127.0.0.1", port2, node=1, tau=1, alpha=0.5)
+        p = c.init_client({"w": np.zeros((2, 2), np.float32)})
+        for _ in range(rounds):
+            p = {"w": p["w"] + 1.0}
+            p, _ = c.sync_client(p)
+        c.close()
+
+    th2 = threading.Thread(target=client2_fn)
+    th2.start()
+    srv2 = AsyncEAServer("127.0.0.1", port2, num_nodes=1)
+    srv2.init_server({"w": np.zeros((2, 2), np.float32)})
+    for _ in range(rounds):
+        srv2.sync_server({"w": np.zeros((2, 2), np.float32)})
+    th2.join(timeout=30)
+    np.testing.assert_allclose(center, srv2.center[0])
+    srv2.close()
+
+
+def test_tester_negotiates_packed_center():
+    port = _ports()
+    out = {}
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5)
+        p = c.init_client(_params())
+        c.sync_client({"w": p["w"] + 1.0, "b": p["b"]})
+        c.close()
+
+    def tester_fn():
+        t = AsyncEATester("127.0.0.1", port, num_nodes=1, codec="raw")
+        out["p"] = t.start_test(_params())
+        t.finish_test()
+        t.close()
+
+    tc = threading.Thread(target=client_fn)
+    tt = threading.Thread(target=tester_fn)
+    tc.start()
+    tt.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1, with_tester=True)
+    srv.init_server(_params())
+    srv.sync_server(_params())
+    assert srv.test_net()
+    tc.join(timeout=30)
+    tt.join(timeout=30)
+    srv.close()
+    np.testing.assert_allclose(out["p"]["w"], 0.5)  # (1-0)*0.5 applied
+
+
+# ---------------------------------------------------------------------------
+# Mixed-version fleets.
+
+def test_new_client_against_old_server_falls_back_to_per_leaf():
+    """An old server replies with the PLAIN string and speaks per-leaf
+    'T' frames; a codec-advertising client must silently downgrade (the
+    backward-compat guard satellite)."""
+    port = _ports(4)
+    center = [np.full((2, 2), 5.0, np.float32)]
+    errs = []
+
+    def old_server():
+        try:
+            bsrv, dsrv = Server("127.0.0.1", port), Server("127.0.0.1",
+                                                           port + 1)
+            bconn = bsrv.accept(1, timeout=30)[0]
+            dconn = dsrv.accept(1, timeout=30)[0]
+            for a in center:                       # init broadcast
+                bconn.send_tensor(a)
+            msg = bconn.recv_msg()                 # Enter? (+ wire advert)
+            assert isinstance(msg, dict) and msg["q"] == ENTER_Q
+            assert "wire" in msg                   # client DID advertise
+            dconn.send_msg(ENTER)                  # plain-string reply
+            assert dconn.recv_msg() == CENTER_Q
+            for a in center:
+                dconn.send_tensor(a)
+            assert dconn.recv_msg() == DELTA_Q
+            dconn.send_msg(DELTA)
+            deltas = [dconn.recv_tensor() for _ in center]
+            np.testing.assert_allclose(deltas[0], 0.5)  # (6-5)*.5
+            for c in (bconn, dconn):
+                c.close()
+            bsrv.close(); dsrv.close()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    th = threading.Thread(target=old_server, daemon=True)
+    th.start()
+    c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                      codec="int8")
+    p = c.init_client({"w": np.zeros((2, 2), np.float32)})
+    p = {"w": p["w"] + 1.0}                        # drift to 6.0
+    p, synced = c.sync_client(p)
+    assert synced and c._packed is False           # downgraded, pinned
+    np.testing.assert_allclose(p["w"], 5.5)
+    c.close()
+    th.join(timeout=30)
+    assert not errs, errs
+
+
+def test_old_client_against_new_server_per_leaf():
+    """codec=None emulates an old-wire client: plain-string handshake,
+    per-leaf frames — the server must serve it unchanged."""
+    out, new_params = _one_sync(_ports(), None)
+    assert out["packed"] is None or out["packed"] is False
+    np.testing.assert_allclose(new_params["w"], 1.0)
+
+
+def test_server_rejects_unsupported_codec_loudly():
+    """A peer advertising a codec this build does not support must get an
+    explicit wire-error reply and an eviction — never a silent-corruption
+    downgrade (tentpole piece 2)."""
+    port = _ports()
+    reply_box = {}
+
+    def bogus_client():
+        from distlearn_tpu.comm import connect
+        b = connect("127.0.0.1", port)
+        d = connect("127.0.0.1", port + 1)
+        b.recv_tensors(n=2)                        # init broadcast
+        b.send_msg({"q": ENTER_Q, "clientID": 1,
+                    "wire": {"v": 1, "codec": "zstd"}})
+        reply_box["reply"] = d.recv_msg()
+        b.close(); d.close()
+
+    th = threading.Thread(target=bogus_client)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1)
+    srv.init_server(_params())
+    with pytest.raises((RuntimeError, TimeoutError, ProtocolError)):
+        # the only client gets evicted -> no live conns to serve
+        srv.sync_server(_params(), timeout=5.0)
+    th.join(timeout=30)
+    assert 1 in srv.evicted
+    srv.close()
+    reply = reply_box["reply"]
+    assert isinstance(reply, dict) and reply["a"] == ENTER
+    assert "unsupported" in reply["wire"]["error"]
+    with pytest.raises(ProtocolError, match="rejected"):
+        _check_wire_reply(reply, ENTER, "zstd")
+
+
+def test_rejoin_renegotiates_packed_wire():
+    """Rejoin must re-run the wire negotiation on the fresh channels and
+    drain overlap state; math stays exact (codec=raw)."""
+    port = _ports()
+    out = {}
+    evicted_ev = threading.Event()
+
+    def flaky_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                          codec="raw", overlap=True)
+        c.init_client(_params())
+        c.broadcast.send_msg({"q": ENTER_Q, "clientID": 1})
+        evicted_ev.wait(timeout=60)
+        p = c.rejoin(_params())
+        out["packed_after_rejoin"] = c._packed
+        p = {"w": p["w"] + 2.0, "b": p["b"] + 2.0}
+        p, synced = c.sync_client(p)
+        out["synced"] = synced
+        out["p"] = p
+        c.close()
+
+    th = threading.Thread(target=flaky_fn)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1,
+                        handshake_timeout=0.5)
+    srv.init_server(_params())
+    with pytest.raises((RuntimeError, TimeoutError)):
+        srv.sync_server(_params(), timeout=5.0)    # evicts the hung client
+    assert 1 in srv.evicted
+    evicted_ev.set()
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            new_params = srv.sync_server(_params(), timeout=5.0)
+            break
+        except (RuntimeError, TimeoutError):
+            assert time.monotonic() < deadline, "rejoin never served"
+            time.sleep(0.05)
+    th.join(timeout=30)
+    srv.close()
+    assert out["synced"] and out["packed_after_rejoin"] is True
+    np.testing.assert_allclose(out["p"]["w"], 1.0)
+    np.testing.assert_allclose(new_params["w"], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Error feedback: quantized-EA tracks fp32-EA.
+
+def _run_ea(port, codec, rounds=50, seed=3):
+    """One client, ``rounds`` tau=1 syncs with a deterministic drift
+    sequence; returns the final server center."""
+    drifts = np.random.RandomState(seed).randn(rounds).astype(np.float32)
+    shape = (8, 5)
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                          codec=codec)
+        p = c.init_client({"w": np.zeros(shape, np.float32)})
+        for r in range(rounds):
+            p = {"w": p["w"] + drifts[r]}
+            p, _ = c.sync_client(p)
+        c.close()
+
+    th = threading.Thread(target=client_fn)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1)
+    srv.init_server({"w": np.zeros(shape, np.float32)})
+    for _ in range(rounds):
+        srv.sync_server({"w": np.zeros(shape, np.float32)})
+    th.join(timeout=60)
+    center = srv.center[0].copy()
+    srv.close()
+    return center
+
+
+@pytest.mark.parametrize("codec", ["int8", "fp16"])
+def test_error_feedback_keeps_quantized_ea_near_fp32(codec):
+    """50 rounds of quantized-EA with client-side residual error feedback
+    must track the fp32-EA trajectory: the per-round quantization error is
+    re-injected, so it cannot accumulate into drift (1-bit SGD, Seide et
+    al. 2014)."""
+    ref = _run_ea(_ports(), "raw")
+    quant = _run_ea(_ports(), codec)
+    scale = float(np.max(np.abs(ref))) + 1e-6
+    # within a few quantization steps of the fp32 fixed point, NOT rounds
+    # of accumulated bias (which would be ~50x a step)
+    rel_err = float(np.max(np.abs(quant - ref))) / scale
+    assert rel_err < 0.05, rel_err
+
+
+# ---------------------------------------------------------------------------
+# The obs-verified acceptance criterion: int8 moves >= 3x fewer payload
+# bytes than legacy fp32 per-leaf, in O(1) frames per sync.
+
+def _measure_sync_bytes(codec):
+    """Run init + ONE tau-cycle; return (payload bytes the sync moved —
+    both directions, from transport_bytes_sent_total — and the packed
+    frame count for the cycle)."""
+    obs.REGISTRY.reset()                  # fresh counters, fresh children
+    port = _ports()
+    # big enough that handshake JSON is noise: 2 leaves, 96 KB fp32 total
+    leaves = {"w": np.random.RandomState(0).randn(128, 128)
+              .astype(np.float32),
+              "b": np.random.RandomState(1).randn(2048)
+              .astype(np.float32)}
+    marks = {}
+
+    def _totals(name):
+        for fam in obs.REGISTRY.snapshot():
+            if fam["name"] == name:
+                return sum(s["value"] for s in fam["samples"])
+        return 0.0
+
+    # the "before" mark must be read with BOTH init paths quiescent —
+    # reading it from the client thread races the server's counter
+    # increments for the init broadcast (sendall returns on the client
+    # side before the sender thread books the bytes under suite load)
+    inited = threading.Event()
+    go = threading.Event()
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                          codec=codec)
+        p = c.init_client({k: v.copy() for k, v in leaves.items()})
+        inited.set()
+        go.wait(timeout=30)
+        p = {k: v + 1.0 for k, v in p.items()}
+        p, synced = c.sync_client(p)
+        assert synced
+        c.close()
+
+    th = threading.Thread(target=client_fn)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1)
+    srv.init_server({k: v.copy() for k, v in leaves.items()})
+    inited.wait(timeout=30)
+    marks["before"] = _totals("transport_bytes_sent_total")
+    marks["frames_before"] = _totals("wire_packed_frames_total")
+    go.set()
+    srv.sync_server({k: v.copy() for k, v in leaves.items()})
+    th.join(timeout=30)
+    srv.close()
+    sync_bytes = _totals("transport_bytes_sent_total") - marks["before"]
+    frames = _totals("wire_packed_frames_total") - marks["frames_before"]
+    return sync_bytes, frames
+
+
+def test_int8_tau_cycle_moves_3x_fewer_bytes_than_legacy_fp32():
+    legacy_bytes, legacy_frames = _measure_sync_bytes(None)
+    int8_bytes, int8_frames = _measure_sync_bytes("int8")
+    assert legacy_frames == 0             # old wire: no 'P' frames at all
+    # O(1) frames per sync: exactly 2 packed frames (center down, delta
+    # up) regardless of leaf count
+    assert int8_frames == 2
+    ratio = legacy_bytes / int8_bytes
+    assert ratio >= 3.0, (legacy_bytes, int8_bytes, ratio)
+
+
+def test_packed_raw_frame_count_is_o1_per_sync():
+    raw_bytes, raw_frames = _measure_sync_bytes("raw")
+    assert raw_frames == 2
+    assert raw_bytes > 0

@@ -1,0 +1,319 @@
+"""Packed/quantized wire codec tests (comm/wire.py + the 'P' frame in
+comm/transport.py): round-trip properties across dtypes and layouts,
+corrupt-manifest hardening (ProtocolError with the stream still
+frame-aligned), legacy interop, and whole-frame throttle pacing.
+"""
+
+import json
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.comm import wire
+from distlearn_tpu.comm.transport import (_HDR, _THDR, Conn, ProtocolError,
+                                          native)
+
+pytestmark = pytest.mark.comm_perf
+
+
+def _pair():
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    a = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    a.connect(lst.getsockname())
+    b, _ = lst.accept()
+    lst.close()
+    return Conn(a), Conn(b)
+
+
+def _leaf_zoo():
+    """Every layout class the codec must survive: float/int/unsigned,
+    0-d, empty, and non-C-contiguous leaves."""
+    rng = np.random.RandomState(7)
+    return [
+        rng.randn(5, 3).astype(np.float32),
+        rng.randn(17).astype(np.float64),
+        rng.randn(2, 2).astype(np.float16),
+        np.arange(12, dtype=np.int64).reshape(3, 4),
+        np.arange(6, dtype=np.uint8),
+        np.float32(3.25).reshape(()),          # 0-d
+        np.zeros((0, 5), np.float32),          # empty
+        np.asfortranarray(rng.randn(4, 6).astype(np.float32)),  # F-order
+        rng.randn(8, 8).astype(np.float32)[::2, 1::3],          # strided view
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Codec level (no sockets).
+
+@pytest.mark.parametrize("codec", wire.CODECS)
+def test_encode_decoded_roundtrip_properties(codec):
+    leaves = _leaf_zoo()
+    payload = wire.encode_leaves(leaves, codec)
+    assert payload.codec == codec
+    assert payload.logical_nbytes == sum(np.asarray(a).nbytes
+                                         for a in leaves)
+    decs = payload.decoded()
+    for a, entry, dec in zip(leaves, payload.manifest["leaves"], decs):
+        a = np.asarray(a)
+        assert dec.shape == a.shape and dec.dtype == a.dtype
+        if entry["enc"] == "raw":
+            np.testing.assert_array_equal(dec, a)
+        elif entry["enc"] == "fp16":
+            np.testing.assert_allclose(dec, a.astype(np.float16), rtol=0)
+        else:                                   # int8: error <= scale/2
+            tol = entry["scale"] / 2 + 1e-12
+            assert np.max(np.abs(dec - a), initial=0.0) <= tol
+    # non-float leaves always ride raw, even inside quantized frames
+    int_entries = [e for a, e in zip(leaves, payload.manifest["leaves"])
+                   if np.asarray(a).dtype.kind not in "fc"]
+    assert all(e["enc"] == "raw" for e in int_entries)
+
+
+def test_quantized_frames_shrink_wire_bytes():
+    leaves = [np.random.RandomState(0).randn(64, 64).astype(np.float32)]
+    raw = wire.encode_leaves(leaves, "raw")
+    fp16 = wire.encode_leaves(leaves, "fp16")
+    int8 = wire.encode_leaves(leaves, "int8")
+    assert fp16.wire_nbytes == raw.wire_nbytes // 2
+    assert int8.wire_nbytes == raw.wire_nbytes // 4
+
+
+def test_int8_zero_leaf_and_nonfinite():
+    payload = wire.encode_leaves([np.zeros((3, 3), np.float32)], "int8")
+    np.testing.assert_array_equal(payload.decoded()[0], 0.0)
+    with pytest.raises(ValueError, match="non-finite"):
+        wire.encode_leaves([np.array([1.0, np.inf], np.float32)], "int8")
+
+
+def test_unknown_codec_rejected():
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        wire.encode_leaves([np.zeros(2, np.float32)], "zstd")
+
+
+def _manifest_bytes(doc):
+    return json.dumps(doc).encode()
+
+
+def test_parse_manifest_structural_rejections():
+    ok = wire.encode_leaves([np.arange(4, dtype=np.float32)], "raw")
+    raw = _manifest_bytes(ok.manifest)
+    assert wire.parse_manifest(raw, 16)[0] == "raw"
+
+    cases = [
+        (b"not json", 16, "undecodable"),
+        (_manifest_bytes({"v": 1}), 16, "not .codec, leaves. shaped"),
+        (_manifest_bytes({"codec": "zstd", "leaves": []}), 0,
+         "unknown wire codec"),
+        (_manifest_bytes({"codec": "raw", "leaves": [
+            {"dtype": "float32", "shape": [-1], "enc": "raw",
+             "offset": 0, "nbytes": 16}]}), 16, "negative dimension"),
+        (_manifest_bytes({"codec": "raw", "leaves": [
+            {"dtype": "float32", "shape": [4], "enc": "gzip",
+             "offset": 0, "nbytes": 16}]}), 16, "unknown encoding"),
+        (_manifest_bytes({"codec": "int8", "leaves": [
+            {"dtype": "int64", "shape": [4], "enc": "int8",
+             "offset": 0, "nbytes": 4, "scale": 1.0}]}), 4, "non-float"),
+        (_manifest_bytes({"codec": "int8", "leaves": [
+            {"dtype": "float32", "shape": [4], "enc": "int8",
+             "offset": 0, "nbytes": 4}]}), 4, "missing scale"),
+        (_manifest_bytes({"codec": "int8", "leaves": [
+            {"dtype": "float32", "shape": [4], "enc": "int8",
+             "offset": 0, "nbytes": 4, "scale": float("nan")}]}), 4,
+         "non-finite int8 scale"),
+        (_manifest_bytes({"codec": "raw", "leaves": [
+            {"dtype": "float32", "shape": [4], "enc": "raw",
+             "offset": 0, "nbytes": 8}]}), 8, "!= 16 expected"),
+        (_manifest_bytes({"codec": "raw", "leaves": [
+            {"dtype": "float32", "shape": [4], "enc": "raw",
+             "offset": 4, "nbytes": 16}]}), 20, "tile"),
+        (_manifest_bytes({"codec": "raw", "leaves": [
+            {"dtype": "float32", "shape": [4], "enc": "raw",
+             "offset": 0, "nbytes": 16}]}), 99, "frame carries"),
+        # hostile huge shape: python-int math, no C-long overflow
+        (_manifest_bytes({"codec": "raw", "leaves": [
+            {"dtype": "float32", "shape": [2 ** 62, 2 ** 62], "enc": "raw",
+             "offset": 0, "nbytes": 16}]}), 16, "expected"),
+    ]
+    for raw, data_nbytes, match in cases:
+        with pytest.raises(ValueError, match=match):
+            wire.parse_manifest(raw, data_nbytes)
+    with pytest.raises(ValueError, match="receiver expects"):
+        wire.parse_manifest(_manifest_bytes(ok.manifest), 16, expect_n=3)
+
+
+# ---------------------------------------------------------------------------
+# Transport level: the 'P' frame over a real socket.
+
+@pytest.mark.parametrize("codec", wire.CODECS)
+def test_packed_socket_roundtrip(codec):
+    tx, rx = _pair()
+    leaves = _leaf_zoo()
+    tx.send_tensors(leaves, codec=codec)
+    got = rx.recv_tensors(n=len(leaves))
+    for a, g in zip(leaves, got):
+        a = np.asarray(a)
+        assert g.shape == a.shape and g.dtype == a.dtype
+        if codec == "raw":
+            np.testing.assert_array_equal(g, a)
+    tx.close(); rx.close()
+
+
+def test_packed_recv_into_preallocated_buffers():
+    tx, rx = _pair()
+    leaves = [np.arange(6, dtype=np.float32).reshape(2, 3),
+              np.arange(4, dtype=np.int64)]
+    out = [np.zeros((2, 3), np.float32), np.zeros(4, np.int64)]
+    tx.send_tensors(leaves)
+    got = rx.recv_tensors(out=out)
+    assert got[0] is out[0] and got[1] is out[1]   # zero realloc
+    np.testing.assert_array_equal(out[0], leaves[0])
+    np.testing.assert_array_equal(out[1], leaves[1])
+    tx.close(); rx.close()
+
+
+def test_recv_tensors_autodetects_legacy_per_leaf_stream():
+    """An old-wire peer sends per-leaf 'T' frames; recv_tensors must parse
+    them without any negotiation branch on the receive side."""
+    tx, rx = _pair()
+    leaves = [np.arange(3, dtype=np.float32), np.arange(5, dtype=np.int32)]
+    tx.send_tensors(leaves, packed=False)          # legacy framing
+    got = rx.recv_tensors(n=2)
+    for a, g in zip(leaves, got):
+        np.testing.assert_array_equal(g, a)
+    tx.close(); rx.close()
+
+
+def test_legacy_framing_rejects_quantized_codecs():
+    tx, rx = _pair()
+    with pytest.raises(ValueError, match="requires the packed frame"):
+        tx.send_tensors([np.zeros(2, np.float32)], codec="int8",
+                        packed=False)
+    tx.close(); rx.close()
+
+
+def test_empty_leaf_list_sends_no_frame():
+    tx, rx = _pair()
+    tx.send_tensors([])
+    assert rx.recv_tensors(n=0) == []
+    tx.send_msg("after")                  # stream still aligned
+    assert rx.recv_msg() == "after"
+    tx.close(); rx.close()
+
+
+def _send_packed_frame(conn, manifest_doc, data: bytes):
+    m = json.dumps(manifest_doc).encode()
+    payload = _THDR.pack(len(m)) + m + data
+    conn._send_frame(ord("P"), payload)
+
+
+def test_corrupt_manifest_protocol_error_and_stream_aligned():
+    """A hostile/corrupt manifest must raise ProtocolError AND consume the
+    announced payload, so the next frame parses normally."""
+    tx, rx = _pair()
+    _send_packed_frame(tx, {"codec": "raw", "leaves": [
+        {"dtype": "float32", "shape": [2], "enc": "raw",
+         "offset": 0, "nbytes": 4}]}, b"\0" * 4)    # nbytes != 8
+    tx.send_msg("still-aligned")
+    with pytest.raises(ProtocolError):
+        rx.recv_tensors(n=1)
+    assert rx.recv_msg() == "still-aligned"
+    tx.close(); rx.close()
+
+
+def test_packed_leaf_count_mismatch_drains():
+    tx, rx = _pair()
+    tx.send_tensors([np.zeros(2, np.float32), np.ones(3, np.float32)])
+    tx.send_msg("next")
+    with pytest.raises(ProtocolError, match="expects"):
+        rx.recv_tensors(n=5)
+    assert rx.recv_msg() == "next"
+    tx.close(); rx.close()
+
+
+def test_packed_out_buffer_mismatch_drains():
+    tx, rx = _pair()
+    tx.send_tensors([np.zeros((2, 2), np.float32)])
+    tx.send_msg("next")
+    with pytest.raises(ProtocolError, match="mismatch"):
+        rx.recv_tensors(out=[np.zeros((3, 3), np.float32)])
+    assert rx.recv_msg() == "next"
+    tx.close(); rx.close()
+
+
+def test_recv_tensors_rejects_unexpected_kind():
+    tx, rx = _pair()
+    tx.send_msg("hello")
+    with pytest.raises(ProtocolError, match="kind"):
+        rx.recv_tensors(n=1)
+    tx.close(); rx.close()
+
+
+def test_recv_tensors_requires_out_or_n():
+    _, rx = _pair()
+    with pytest.raises(ValueError):
+        rx.recv_tensors()
+    rx.close()
+
+
+def test_pure_python_sendv_path(monkeypatch):
+    """Force the no-native fallback: single-sendmsg framing (the coalesced
+    header+payload satellite) must round-trip msgs, tensors, and packed
+    frames."""
+    monkeypatch.setattr(native, "available", lambda: False)
+    tx, rx = _pair()
+    tx.send_msg({"q": "ping"})
+    assert rx.recv_msg() == {"q": "ping"}
+    arr = np.arange(10, dtype=np.float64).reshape(2, 5)
+    tx.send_tensor(arr)
+    np.testing.assert_array_equal(rx.recv_tensor(), arr)
+    tx.send_tensor(np.array(2.5, np.float32))      # 0-d
+    assert rx.recv_tensor().shape == ()
+    leaves = _leaf_zoo()
+    tx.send_tensors(leaves, codec="raw")
+    got = rx.recv_tensors(n=len(leaves))
+    np.testing.assert_array_equal(got[0], leaves[0])
+    tx.close(); rx.close()
+
+
+def test_throttle_budgets_whole_packed_frame():
+    """throttle_bps must pace on the TOTAL packed frame size: sending
+    ~400 KB at 1 MB/s takes >= ~0.4s whether packed or per-leaf (the
+    satellite fix — a per-leaf-only budget would let packed frames bypass
+    the localhost bandwidth emulation)."""
+    tx, rx = _pair()
+    tx.throttle_bps = 1e6
+    leaves = [np.zeros(50_000, np.float32), np.zeros(50_000, np.float32)]
+    nbytes = sum(a.nbytes for a in leaves)         # 400 KB
+    done = []
+
+    import threading
+    th = threading.Thread(target=lambda: done.append(
+        rx.recv_tensors(n=len(leaves))), daemon=True)
+    th.start()
+    t0 = time.perf_counter()
+    tx.send_tensors(leaves, codec="raw")
+    elapsed = time.perf_counter() - t0
+    th.join(timeout=30)
+    assert len(done) == 1
+    assert elapsed >= 0.9 * nbytes / tx.throttle_bps
+    tx.close(); rx.close()
+
+
+def test_oversized_manifest_header_rejected():
+    tx, rx = _pair()
+    payload = _THDR.pack(10_000) + b"x" * 4        # hlen > frame
+    tx._send_frame(ord("P"), payload)
+    with pytest.raises(ProtocolError):
+        rx.recv_tensors(n=1)
+    tx.close(); rx.close()
+
+
+def test_hdr_struct_unchanged():
+    """The 'P' frame rides the existing kind:u8|len:u64le framing — a
+    change here is a wire-protocol break."""
+    assert _HDR.size == 9 and _HDR.pack(ord("P"), 1)[0] == ord("P")

@@ -96,6 +96,31 @@ def load_run(paths: list[str]) -> dict:
             "gauges": gauges, "histograms": hists}
 
 
+_WIRE_FAMS = {"wire_packed_frames_total": "frames",
+              "wire_packed_bytes_total": "wire_bytes",
+              "wire_logical_bytes_total": "logical_bytes"}
+
+
+def wire_table(counters: dict) -> dict:
+    """Derive the packed-wire table from the wire_* counter families:
+    per codec, frame count, wire bytes, logical (pre-encoding) bytes, and
+    the compression ratio logical/wire.  Empty when the run never sent a
+    packed frame."""
+    tab: dict[str, dict] = {}
+    for key, v in counters.items():
+        for fam, col in _WIRE_FAMS.items():
+            prefix = fam + '{codec="'
+            if key.startswith(prefix) and key.endswith('"}'):
+                codec = key[len(prefix):-2]
+                row = tab.setdefault(codec, {c: 0.0 for c in
+                                             _WIRE_FAMS.values()})
+                row[col] += v
+    for row in tab.values():
+        row["ratio"] = (row["logical_bytes"] / row["wire_bytes"]
+                        if row["wire_bytes"] else float("nan"))
+    return dict(sorted(tab.items()))
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -116,7 +141,8 @@ def summarize_run(paths: list[str]) -> dict:
             "counters": dict(sorted(run["counters"].items())),
             "counter_totals": dict(sorted(run["counter_totals"].items())),
             "gauges": dict(sorted(run["gauges"].items())),
-            "histograms": hist_tab}
+            "histograms": hist_tab,
+            "wire": wire_table(run["counters"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -137,7 +163,21 @@ def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
             "p95_delta": sb.get("p95", float("nan"))
             - sa.get("p95", float("nan")),
         }
-    return {"counters": counters, "spans": spans}
+    wire = {}
+    wa, wb = a.get("wire", {}), b.get("wire", {})
+    for codec in sorted(set(wa) | set(wb)):
+        ra = wa.get(codec, {})
+        rb = wb.get(codec, {})
+        wire[codec] = {
+            "frames": {"a": ra.get("frames", 0), "b": rb.get("frames", 0)},
+            "wire_bytes": {"a": ra.get("wire_bytes", 0),
+                           "b": rb.get("wire_bytes", 0),
+                           "delta": rb.get("wire_bytes", 0)
+                           - ra.get("wire_bytes", 0)},
+            "ratio": {"a": ra.get("ratio", float("nan")),
+                      "b": rb.get("ratio", float("nan"))},
+        }
+    return {"counters": counters, "spans": spans, "wire": wire}
 
 
 def _fmt_s(v: float) -> str:
@@ -177,6 +217,14 @@ def _print_summary(doc: dict):
         for key, row in doc["histograms"].items():
             print(f"  {key}: count={row['count']} "
                   f"mean={_fmt_s(row['mean'])} sum={_fmt_s(row['sum'])}")
+        print()
+    if doc.get("wire"):
+        print(f"{'packed wire':<12} {'frames':>8} {'wire bytes':>14} "
+              f"{'logical bytes':>14} {'ratio':>7}")
+        for codec, row in doc["wire"].items():
+            print(f"{codec:<12} {row['frames']:>8g} "
+                  f"{row['wire_bytes']:>14g} {row['logical_bytes']:>14g} "
+                  f"{row['ratio']:>7.2f}")
 
 
 def _print_diff(doc: dict):
@@ -192,6 +240,15 @@ def _print_diff(doc: dict):
             cnt = f"{row['count']['a']}/{row['count']['b']}"
             print(f"{name:<40} {cnt:>12} {_fmt_s(row['p50_delta']):>10} "
                   f"{_fmt_s(row['p95_delta']):>10}")
+        print()
+    if doc.get("wire"):
+        print(f"{'packed wire':<12} {'frames a/b':>12} "
+              f"{'dwire bytes':>14} {'ratio a/b':>14}")
+        for codec, row in doc["wire"].items():
+            cnt = f"{row['frames']['a']:g}/{row['frames']['b']:g}"
+            ratio = f"{row['ratio']['a']:.2f}/{row['ratio']['b']:.2f}"
+            print(f"{codec:<12} {cnt:>12} "
+                  f"{row['wire_bytes']['delta']:>+14g} {ratio:>14}")
 
 
 def main(argv=None) -> int:
