@@ -1200,6 +1200,120 @@ def async_ea_bench(param_mb: int = 8, n_clients: int = 2,
     }
 
 
+def host_shard_bench(n_clients: int = 4, syncs_per_client: int = 4,
+                     shard_counts=(1, 2, 4)):
+    """Striped parameter-server scaling: the CONCURRENT AsyncEA server at
+    S ∈ ``shard_counts`` stripes with ``n_clients`` hammering it, per
+    wire param set, plus a ``baseline`` run (S=1 server, clients with the
+    shard negotiation DISABLED — exactly the pre-shard packed path, so
+    ``s1_vs_baseline`` measures what the sharded plumbing costs when it
+    buys nothing).
+
+    Two regimes: the raw loopback (memcpy/GIL-bound on a shared CPU —
+    sharding mostly can't win here and the numbers say by how much it
+    doesn't lose) and emulated fixed-bandwidth links via
+    ``Conn.throttle_bps`` (the multi-host regime sharding is FOR: each
+    stripe channel is its own paced link, the way each shard of a real
+    deployment owns its own NIC path, so one client's sync drains S links
+    concurrently and ``shard_speedup`` approaches S)."""
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.parallel.async_ea import (AsyncEAClient,
+                                                 AsyncEAServerConcurrent)
+    from distlearn_tpu.utils.logging import set_verbose
+    set_verbose(False)
+
+    smax = max(shard_counts)
+
+    def run(shapes, shards, sharded_clients, bps, spc):
+        # broadcast + dedicated per client + test + S-1 shard listeners
+        port = _reserve_port_window(n_clients + smax + 1)
+        params = {f"p{i}": np.random.RandomState(i).randn(*s)
+                  .astype(np.float32) for i, s in enumerate(shapes)}
+        total = n_clients * spc
+        out: dict = {}
+        errs: list = []
+
+        def server():
+            try:
+                srv = AsyncEAServerConcurrent(
+                    "127.0.0.1", port, num_nodes=n_clients,
+                    accept_timeout=60.0, shards=shards, throttle_bps=bps)
+                srv.init_server({k: v.copy() for k, v in params.items()})
+                srv.start()
+                t0 = _t.perf_counter()
+                while (srv.syncs_completed < total and srv.live_clients > 0
+                       and _t.perf_counter() - t0 < 600):
+                    _t.sleep(0.005)
+                out["sec"] = _t.perf_counter() - t0
+                out["syncs"] = srv.syncs_completed
+                out["stripes"] = len(srv.stripes)
+                srv.stop()
+                srv.close()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        def client(node):
+            try:
+                cl = AsyncEAClient("127.0.0.1", port, node=node, tau=1,
+                                   alpha=0.5, sharded=sharded_clients,
+                                   throttle_bps=bps)
+                p = cl.init_client({k: v.copy()
+                                    for k, v in params.items()})
+                for _ in range(spc):
+                    p, _ = cl.sync_client(p)
+                cl.close()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=server, daemon=True)]
+        ts += [threading.Thread(target=client, args=(i + 1,), daemon=True)
+               for i in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=600)
+        if errs:
+            raise errs[0]
+        if "sec" not in out or out["syncs"] < total:
+            raise RuntimeError(
+                f"shard bench incomplete: {out.get('syncs')} of {total}")
+        return {"syncs_per_sec": out["syncs"] / out["sec"],
+                "stripes": out["stripes"]}
+
+    # 25 MB/s keeps the paced wire-time (which striping parallelizes)
+    # well above the encode/memcpy CPU time (which it cannot), so the
+    # emulated rows measure the link-bound regime sharding targets
+    # rather than this host's single-core codec throughput.
+    bps = float(os.environ.get("BENCH_SHARD_EMULATED_LINK_MB_S",
+                               "25")) * 1e6
+    result: dict = {}
+    for set_name, shapes in _WIRE_PARAM_SETS.items():
+        nbytes = sum(4 * int(np.prod(s)) for s in shapes)
+        rows: dict = {"leaves": len(shapes), "param_mb": nbytes / 1e6,
+                      "clients": n_clients,
+                      "syncs_per_client": syncs_per_client,
+                      "emulated_link_mb_s": bps / 1e6}
+        for regime, rbps in (("loopback", None), ("emulated", bps)):
+            reg: dict = {"baseline": run(shapes, 1, False, rbps,
+                                         syncs_per_client)}
+            for s in shard_counts:
+                reg[f"s{s}"] = run(shapes, s, True, rbps,
+                                   syncs_per_client)
+            rows[regime] = reg
+            rows[f"{regime}_shard_speedup"] = (
+                reg[f"s{smax}"]["syncs_per_sec"]
+                / reg["s1"]["syncs_per_sec"])
+            rows[f"{regime}_s1_vs_baseline"] = (
+                reg["s1"]["syncs_per_sec"]
+                / reg["baseline"]["syncs_per_sec"])
+        result[set_name] = rows
+    return result
+
+
 def bench_resnet50(batch: int, iters: int, windows: int, peak,
                    norm: str = "batch"):
     """ResNet-50/ImageNet-shape utilization bench (the model where MFU is
@@ -1999,6 +2113,25 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"[bench] asyncEA concurrent bench failed: {e}",
                   file=sys.stderr)
+
+    # --- sharded center: striped parameter-server scaling --------------------
+    if os.environ.get("BENCH_SKIP_SHARD") != "1":
+        try:
+            details["host_shard"] = host_shard_bench(
+                int(os.environ.get("BENCH_SHARD_CLIENTS", "4")),
+                int(os.environ.get("BENCH_SHARD_SYNCS", "4")))
+            for set_name, w in details["host_shard"].items():
+                print(f"[bench] shard {set_name} ({w['param_mb']:.1f}MB x"
+                      f"{w['clients']} clients): emulated "
+                      f"{w['emulated']['s1']['syncs_per_sec']:.2f} -> "
+                      f"{w['emulated']['s4']['syncs_per_sec']:.2f} syncs/s "
+                      f"S=1->4 ({w['emulated_shard_speedup']:.2f}x on "
+                      f"{w['emulated_link_mb_s']:.0f} MB/s links; loopback "
+                      f"{w['loopback_shard_speedup']:.2f}x; S=1 at "
+                      f"{w['emulated_s1_vs_baseline']:.2f}x of unsharded "
+                      "baseline)", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] shard bench failed: {e}", file=sys.stderr)
 
     # --- ResNet-50 utilization bench ---------------------------------------
     if os.environ.get("BENCH_SKIP_RESNET") != "1" and platform == "tpu":

@@ -15,6 +15,8 @@ import threading
 
 import numpy as np
 
+from distlearn_tpu.comm.errors import PeerClosed
+
 _lib = None
 _tried = False
 _lock = threading.Lock()
@@ -87,7 +89,7 @@ _TIMEOUT_ERRNOS = {_errno.EAGAIN, _errno.EWOULDBLOCK, _errno.ETIMEDOUT}
 
 def _check_rc(rc: int, what: str) -> None:
     if rc == -1:
-        raise ConnectionError("peer closed connection")
+        raise PeerClosed("peer closed connection")
     if rc == -2:
         # FIN landed after partial progress: a torn frame, not a finished
         # peer — surfaced as the reset subclass so drop-policy code

@@ -34,6 +34,7 @@ import numpy as np
 
 from distlearn_tpu import obs
 from distlearn_tpu.comm import native, wire
+from distlearn_tpu.comm.errors import PeerClosed
 
 _HDR = struct.Struct("<BQ")   # kind, payload length
 _THDR = struct.Struct("<I")   # tensor header length
@@ -192,8 +193,8 @@ class Conn:
     def _recv_exact(self, n: int, out: memoryview | None = None,
                     mid_frame: bool = False,
                     deadline: float | None = None) -> memoryview:
-        """Read exactly ``n`` bytes.  A peer FIN raises plain
-        ``ConnectionError("peer closed connection")`` ONLY when it lands
+        """Read exactly ``n`` bytes.  A peer FIN raises
+        :class:`PeerClosed` ONLY when it lands
         before any byte of a fresh frame (a finished peer); a FIN after
         partial progress — or anywhere once ``mid_frame`` marks this read
         as continuing an already-started frame — raises
@@ -230,7 +231,7 @@ class Conn:
                         if got or mid_frame:
                             raise ConnectionResetError(
                                 "peer closed connection mid-frame")
-                        raise ConnectionError("peer closed connection")
+                        raise PeerClosed("peer closed connection")
                     got += r
             finally:
                 try:
@@ -244,8 +245,8 @@ class Conn:
             if native.available():
                 try:
                     native.recv_exact(self._fd, buf, n)
-                except ConnectionError as e:
-                    if mid_frame and type(e) is ConnectionError:
+                except PeerClosed as e:
+                    if mid_frame:
                         raise ConnectionResetError(
                             "peer closed connection mid-frame") from e
                     raise
@@ -259,7 +260,7 @@ class Conn:
                     if got or mid_frame:
                         raise ConnectionResetError(
                             "peer closed connection mid-frame")
-                    raise ConnectionError("peer closed connection")
+                    raise PeerClosed("peer closed connection")
                 got += r
         except BlockingIOError as e:   # SO_RCVTIMEO expired -> EAGAIN
             _timeouts().labels(op="recv").inc()
@@ -664,12 +665,10 @@ class Server:
                     # peer is broken/desynced (its stream can't be resumed) —
                     # drop it and keep serving the rest.
                     c.close()
-                    # both the python and native recv paths raise exactly
-                    # ConnectionError("peer closed connection") for a clean
-                    # FIN; resets/desyncs surface as subclasses or other
-                    # messages
-                    clean_eof = (type(e) is ConnectionError
-                                 and str(e) == "peer closed connection")
+                    # both the python and native recv paths raise PeerClosed
+                    # for a clean FIN; resets/desyncs surface as other
+                    # ConnectionError subclasses or ProtocolError/ValueError
+                    clean_eof = isinstance(e, PeerClosed)
                     _drops().labels(
                         reason="eof" if clean_eof else "desync").inc()
                     if on_drop is not None and not clean_eof:

@@ -121,6 +121,116 @@ def encode_leaves(leaves, codec: str = "raw") -> PackedPayload:
     return PackedPayload(manifest, bufs, codec, offset, logical)
 
 
+def plan_stripes(nbytes: list[int], shards: int) -> list[tuple[int, int]]:
+    """Partition a leaf list into at most ``shards`` contiguous,
+    byte-balanced stripes (Dean et al. 2012 parameter-server sharding,
+    applied to a pytree leaf schedule).
+
+    Returns ``[(lo, hi), ...]`` half-open index ranges covering
+    ``[0, len(nbytes))`` in order.  Greedy walk: each stripe takes leaves
+    until adding the next one would move it FURTHER from the ideal
+    remaining-bytes/remaining-stripes share than stopping; every stripe
+    takes at least one leaf, so the effective stripe count is
+    ``min(shards, len(nbytes))``.  Deterministic in the leaf schedule —
+    but the AsyncEA handshake still ships the explicit ranges so a
+    version skew in this planner can never desync two peers.
+    """
+    n = len(nbytes)
+    if n == 0:
+        return [(0, 0)]
+    shards = max(1, min(int(shards), n))
+    total = sum(nbytes)
+    stripes: list[tuple[int, int]] = []
+    lo, remaining = 0, total
+    for s in range(shards):
+        want = remaining / (shards - s)
+        hi, size = lo, 0
+        max_hi = n - (shards - s - 1)       # leave >=1 leaf per later stripe
+        while hi < max_hi:
+            nb = nbytes[hi]
+            if hi > lo and abs(size + nb - want) > abs(size - want):
+                break
+            size += nb
+            hi += 1
+        stripes.append((lo, hi))
+        lo, remaining = hi, remaining - size
+    lo_last, _ = stripes[-1]
+    stripes[-1] = (lo_last, n)              # tail always closes the range
+    return stripes
+
+
+def plan_splits(nbytes: list[int], nelems: list[int],
+                shards: int) -> list[int]:
+    """Per-leaf split counts for sub-leaf striping: any leaf bigger than
+    the ideal per-stripe byte share is cut into that many equal-element
+    chunks BEFORE stripe planning, so a single oversized kernel (e.g. a
+    convnet's last conv holding 3/4 of the bytes) cannot Amdahl-bound
+    the sharded pipeline — the reason the classic parameter servers
+    split large tensors across shards (Dean et al. 2012 §4.1).
+
+    Returns one ``parts`` count per leaf (1 = unsplit); all 1 when
+    ``shards <= 1``.  Deterministic in (sizes, shards) — but like the
+    stripe ranges, the AsyncEA handshake ships the split table
+    explicitly so planner skew can never desync two peers."""
+    n = len(nbytes)
+    if int(shards) <= 1 or n == 0:
+        return [1] * n
+    target = sum(nbytes) / int(shards)
+    if target <= 0:
+        return [1] * n
+    return [1 if nb <= target or ne <= 1
+            else min(ne, -(-nb // max(1, int(target))))
+            for nb, ne in zip(nbytes, nelems)]
+
+
+def _split_bounds(n: int, parts: int) -> list[tuple[int, int]]:
+    """Half-open element ranges cutting ``n`` elements into ``parts``
+    near-equal chunks (the first ``n % parts`` chunks take the extra
+    element) — the ONE place the chunk arithmetic lives, shared by both
+    peers' view builders so their layouts agree by construction."""
+    base, rem = divmod(n, parts)
+    bounds, lo = [], 0
+    for i in range(parts):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def split_views(leaves: list[np.ndarray], splits: list[int]
+                ) -> list[np.ndarray]:
+    """The VIRTUAL leaf list striping operates over: unsplit leaves pass
+    through with their real shapes; split leaves become contiguous flat
+    chunk views (zero-copy — writes through a view land in the real
+    leaf).  Both AsyncEA peers derive this from the same split table, so
+    per-chunk wire frames line up index-for-index."""
+    out: list[np.ndarray] = []
+    for t, p in zip(leaves, splits):
+        if p <= 1:
+            out.append(t)
+        else:
+            flat = t.reshape(-1)
+            out.extend(flat[lo:hi] for lo, hi in _split_bounds(t.size, p))
+    return out
+
+
+def merge_views(vleaves: list[np.ndarray], splits: list[int],
+                shapes: list[tuple]) -> list[np.ndarray]:
+    """Rebuild the real leaf list from a virtual one (inverse of
+    :func:`split_views`): split leaves concatenate their chunks back to
+    ``shapes`` (copying only those), unsplit leaves pass through."""
+    out, i = [], 0
+    for shape, p in zip(shapes, splits):
+        if p <= 1:
+            out.append(vleaves[i])
+            i += 1
+        else:
+            flat = np.concatenate([np.ravel(c) for c in vleaves[i:i + p]])
+            out.append(flat.reshape(shape))
+            i += p
+    return out
+
+
 def wire_dtype(entry: dict) -> np.dtype:
     """The dtype of a leaf's bytes ON THE WIRE (its logical dtype for raw
     leaves, the quantized dtype otherwise)."""

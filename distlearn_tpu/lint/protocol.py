@@ -40,7 +40,9 @@ from distlearn_tpu.lint.core import Finding
 __all__ = [
     "Op", "send", "recv", "recv_any",
     "tree_allreduce_schedule", "ring_allreduce_schedule",
-    "async_ea_sync_schedule", "check_schedules", "lock_order_audit",
+    "async_ea_sync_schedule", "async_ea_sharded_schedule",
+    "async_ea_rejoin_sharded_schedule", "check_schedules",
+    "lock_order_audit",
 ]
 
 
@@ -51,18 +53,23 @@ class Op:
     kind: str           # 'send' | 'recv' | 'recv_any'
     peer: object = None  # rank id; None for recv_any
     tag: str = ""       # message label, checked on delivery (DL104)
+    #: op is armed with an IO timeout whose expiry ABORTS the rank's
+    #: remaining schedule (the AsyncEA server's handshake_timeout -> evict
+    #: path).  The simulator only reports DL101 for ranks stuck on ops
+    #: that cannot time out.
+    timeout: bool = False
 
 
-def send(peer, tag=""):
-    return Op("send", peer, tag)
+def send(peer, tag="", timeout=False):
+    return Op("send", peer, tag, timeout)
 
 
-def recv(peer, tag=""):
-    return Op("recv", peer, tag)
+def recv(peer, tag="", timeout=False):
+    return Op("recv", peer, tag, timeout)
 
 
-def recv_any(tag=""):
-    return Op("recv_any", None, tag)
+def recv_any(tag="", timeout=False):
+    return Op("recv_any", None, tag, timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -143,6 +150,87 @@ def async_ea_sync_schedule(num_leaves: int = 2, *, client_order=None,
     return {"S": server, "C": client}
 
 
+def _stripe_leg_server(c: str, to: bool) -> list:
+    """Server half of one stripe leg (``_serve_stripe_leg``): center slice
+    down, delta slice up, every recv armed with handshake_timeout."""
+    return [recv(c, "Center?", timeout=to), send(c, "center_p"),
+            recv(c, "delta?", timeout=to), send(c, "delta"),
+            recv(c, "delta_p", timeout=to)]
+
+
+def _stripe_leg_client(s: str) -> list:
+    """Client half of one stripe leg (strict: a client has no timeouts)."""
+    return [send(s, "Center?"), recv(s, "center_p"),
+            send(s, "delta?"), recv(s, "delta"), send(s, "delta_p")]
+
+
+def async_ea_sharded_schedule(num_shards: int = 4, *,
+                              server_timeouts: bool = False,
+                              truncate_tail: int = 0) -> dict:
+    """One SHARDED AsyncEA sync round (``AsyncEAServer._serve_striped`` /
+    the striped ``AsyncEAClient.sync_client``).
+
+    Ranks ``S0..S{n-1}`` are the server's per-stripe serving legs (S0 the
+    dedicated-channel worker, Ss the shard-endpoint workers); ``C0..``
+    are the client's fanned-out stripe legs.  Admission rides leg 0 only;
+    the synthetic ``go`` messages model the client's thread fan-out (no
+    shard leg speaks before leg 0's Enter reply lands), and each shard
+    leg opens with its ``Shard?`` hello exactly like a first dial.
+
+    ``server_timeouts=True`` arms every server recv with the
+    handshake-timeout abort (the eviction path); ``truncate_tail`` drops
+    that many trailing ops from EVERY client leg (a client dying
+    mid-stripe).  Together they assert the eviction schedule drains —
+    and without the timeouts, that the truncation would be a real DL101.
+    """
+    n = max(2, int(num_shards))
+    to = bool(server_timeouts)
+    sched: dict = {"S0": [recv_any("Enter?", timeout=to), send("C0", "Enter")]
+                   + _stripe_leg_server("C0", to)}
+    for s in range(1, n):
+        sched[f"S{s}"] = ([recv(f"C{s}", "Shard?", timeout=to)]
+                          + _stripe_leg_server(f"C{s}", to))
+    sched["C0"] = ([send("S0", "Enter?"), recv("S0", "Enter")]
+                   + [send(f"C{s}", "go") for s in range(1, n)]
+                   + _stripe_leg_client("S0"))
+    for s in range(1, n):
+        sched[f"C{s}"] = ([recv("C0", "go"), send(f"S{s}", "Shard?")]
+                          + _stripe_leg_client(f"S{s}"))
+    if truncate_tail:
+        for r in list(sched):
+            if r.startswith("C"):
+                sched[r] = sched[r][:-truncate_tail]
+    return sched
+
+
+def async_ea_rejoin_sharded_schedule(num_shards: int = 4) -> dict:
+    """An evicted sharded client's readmission (``_readmit`` streams the
+    FULL center on the fresh dedicated channel) followed by its first
+    striped sync: the Rejoin reply re-advertises the stripe plan, the
+    client re-dials every shard endpoint (fresh ``Shard?`` hellos — the
+    server dropped its old shard conns at eviction), so every stripe is
+    resynced by construction."""
+    n = max(2, int(num_shards))
+    sched = {"S0": [recv_any("Rejoin?"), send("C0", "Rejoin"),
+                    send("C0", "center"), recv("C0", "ack"),
+                    recv_any("Enter?"), send("C0", "Enter")]
+             + _stripe_leg_server("C0", False)}
+    for s in range(1, n):
+        sched[f"S{s}"] = ([recv(f"C{s}", "Shard?")]
+                          + _stripe_leg_server(f"C{s}", False))
+    # _announce parses the reply (re-dialing the shard channels) BEFORE
+    # rejoin() receives the center — hence go-then-center on leg 0
+    sched["C0"] = ([send("S0", "Rejoin?"), recv("S0", "Rejoin")]
+                   + [send(f"C{s}", "go") for s in range(1, n)]
+                   + [recv("S0", "center"), send("S0", "ack"),
+                      send("S0", "Enter?"), recv("S0", "Enter")]
+                   + _stripe_leg_client("S0"))
+    for s in range(1, n):
+        sched[f"C{s}"] = ([recv("C0", "go"), send(f"S{s}", "Shard?")]
+                          + _stripe_leg_client(f"S{s}"))
+    return sched
+
+
 # ---------------------------------------------------------------------------
 # The simulator.
 
@@ -169,39 +257,52 @@ def check_schedules(schedules: Mapping, *, buffered_sends: bool = True,
                 where=f"{name}/rank {r}"))
         pc[r] += 1
 
-    progress = True
-    while progress:
-        progress = False
-        for r in list(schedules):
-            op = cur(r)
-            if op is None:
-                continue
-            if op.kind == "send":
-                if buffered_sends:
-                    chan.setdefault((r, op.peer), deque()).append(op.tag)
-                    pc[r] += 1
-                    progress = True
-                else:
-                    peer_op = cur(op.peer)
-                    if peer_op is not None and (
-                            (peer_op.kind == "recv" and peer_op.peer == r)
-                            or peer_op.kind == "recv_any"):
-                        deliver(op.peer, peer_op, op.tag, r)
+    while True:
+        progress = True
+        while progress:
+            progress = False
+            for r in list(schedules):
+                op = cur(r)
+                if op is None:
+                    continue
+                if op.kind == "send":
+                    if buffered_sends:
+                        chan.setdefault((r, op.peer), deque()).append(op.tag)
                         pc[r] += 1
                         progress = True
-            elif op.kind == "recv":
-                q = chan.get((op.peer, r))
-                if q:
-                    deliver(r, op, q.popleft(), op.peer)
-                    progress = True
-            elif op.kind == "recv_any":
-                for (src, dst), q in chan.items():
-                    if dst == r and q:
-                        deliver(r, op, q.popleft(), src)
+                    else:
+                        peer_op = cur(op.peer)
+                        if peer_op is not None and (
+                                (peer_op.kind == "recv"
+                                 and peer_op.peer == r)
+                                or peer_op.kind == "recv_any"):
+                            deliver(op.peer, peer_op, op.tag, r)
+                            pc[r] += 1
+                            progress = True
+                elif op.kind == "recv":
+                    q = chan.get((op.peer, r))
+                    if q:
+                        deliver(r, op, q.popleft(), op.peer)
                         progress = True
-                        break
+                elif op.kind == "recv_any":
+                    for (src, dst), q in chan.items():
+                        if dst == r and q:
+                            deliver(r, op, q.popleft(), src)
+                            progress = True
+                            break
 
-    stuck = {r: cur(r) for r in schedules if cur(r) is not None}
+        stuck = {r: cur(r) for r in schedules if cur(r) is not None}
+        timed = [r for r, op in stuck.items() if op.timeout]
+        if not timed:
+            break
+        # IO-timeout model: a rank stuck on a timeout-armed op ABORTS its
+        # remaining schedule (the AsyncEA server's handshake_timeout fires
+        # and the client is evicted — that serving leg abandons the rest
+        # of its ops) and the simulation continues; only ranks that can
+        # NEVER unblock are a DL101.
+        for r in timed:
+            pc[r] = len(schedules[r])
+
     if stuck:
         findings.append(_deadlock_finding(stuck, pc, name))
     return findings
@@ -390,6 +491,16 @@ def lint_comm_protocols(*, num_nodes: int = 7) -> list[Finding]:
                                 name="async_ea.sync")
     findings += check_schedules(async_ea_sync_schedule(packed=True),
                                 name="async_ea.sync-packed")
+    # sharded center: clean round and rejoin must drain STRICT (no
+    # timeout crutch); the mid-stripe death drains only because every
+    # server recv is handshake_timeout-armed -> evict
+    findings += check_schedules(async_ea_sharded_schedule(4),
+                                name="async_ea.sync-sharded")
+    findings += check_schedules(async_ea_rejoin_sharded_schedule(4),
+                                name="async_ea.rejoin-sharded")
+    findings += check_schedules(
+        async_ea_sharded_schedule(4, server_timeouts=True, truncate_tail=1),
+        name="async_ea.evict-mid-stripe")
     from distlearn_tpu.comm import ring, transport, tree
     from distlearn_tpu.parallel import async_ea
     findings += lock_order_audit([transport, tree, ring, async_ea],

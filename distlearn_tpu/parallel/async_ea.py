@@ -53,6 +53,10 @@ DELTA_Q = "delta?"
 DELTA = "delta"
 TEST_Q = "Test?"
 ACK = "Ack"
+SHARD_Q = "Shard?"
+
+#: Shard-negotiation schema version (the "shard" key in Enter?/Rejoin?).
+SHARD_V = 1
 
 # ---------------------------------------------------------------------------
 # Wire negotiation (packed 'P' frames + codecs, comm/wire.py).
@@ -105,6 +109,193 @@ def _check_wire_reply(reply, want: str, codec: str) -> bool:
     raise ProtocolError(f"protocol desync: expected {want!r}, got {reply!r}")
 
 
+# ---------------------------------------------------------------------------
+# Sharded center (Dean et al. 2012 applied to the EASGD hub).
+#
+# The server may stripe its leaf list into S contiguous byte-balanced
+# ranges (wire.plan_stripes).  Stripe 0 always rides the existing
+# dedicated channel — an unsharded sync IS the one-stripe special case —
+# and stripes 1..S-1 get their own listener ports and per-stripe locks,
+# so different clients' syncs on different stripes proceed concurrently
+# and one client's stripes pipeline (stripe i's apply/reply overlaps
+# stripe i+1's recv).  Negotiation piggybacks the wire handshake: a
+# client adds {"shard": {"v": 1}} to its Enter?/Rejoin? advertisement
+# (packed wire only), and the server's dict reply carries the explicit
+# stripe plan {"shard": {"v", "n", "ports", "stripes"}} — old peers on
+# either side never see the extra key and keep the S=1 legacy behavior.
+# The client then dials each shard port once, introduces itself with a
+# {"q": "Shard?", "clientID", "shard"} hello, and reuses those
+# connections for every subsequent sync (rejoin re-dials them).
+
+
+def _fanout(fns):
+    """Run thunks concurrently — leg 0 on the calling thread, the rest on
+    transient threads — and re-raise the first failure only after EVERY
+    leg has settled, so a caller's eviction/cleanup never races a
+    still-running leg."""
+    if len(fns) == 1:
+        fns[0]()
+        return
+    import threading
+    errs: list = [None] * len(fns)
+
+    def run(i):
+        try:
+            fns[i]()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(1, len(fns))]
+    for t in threads:
+        t.start()
+    run(0)
+    for t in threads:
+        t.join()
+    for e in errs:
+        if e is not None:
+            raise e
+
+
+class _ShardEndpoint:
+    """One shard channel: a listener on its own port plus the per-client
+    conns registered by ``Shard?`` hellos.  Clients dial lazily after the
+    Enter reply advertises the stripe plan; a registered conn persists
+    across syncs and a re-hello for the same cid (rejoin) supersedes it.
+    """
+
+    def __init__(self, host: str, port: int, shard: int, num_nodes: int,
+                 throttle_bps: float | None = None):
+        import threading
+        self.shard = shard
+        self.num_nodes = num_nodes
+        self.throttle_bps = throttle_bps
+        self.server = Server(host, port)
+        # Several stripe workers poll this listener concurrently;
+        # Server.accept's settimeout dance is not thread-safe (one
+        # thread's finally-reset flips a racing thread's in-flight accept
+        # to fully blocking).  A non-blocking listener makes the race
+        # benign: the losing accept gets BlockingIOError and moves on.
+        self.server.sock.setblocking(False)
+        self.port = self.server.port
+        self.conns: dict[int, Conn] = {}
+        self._reg_lock = threading.Lock()   # guards the conns dict only
+
+    def _poll_accept(self, wait: float) -> bool:
+        """Accept at most one pending dial and register it by its hello.
+        Runs lock-free (multiple stripe workers may poll concurrently;
+        each services a different accepted socket) — only the dict
+        update takes the registration lock.  Returns True when the
+        listener had a dial pending (even if another worker won it or
+        the hello was bad), so callers can drain the backlog."""
+        r, _, _ = select.select([self.server.sock], [], [], wait)
+        if not r:
+            return False
+        try:
+            raw, _ = self.server.sock.accept()
+        except (BlockingIOError, OSError):
+            return True             # another stripe worker won this dial
+        raw.setblocking(True)       # BSD inherits O_NONBLOCK from listener
+        c = Conn(raw)
+        try:
+            c.set_timeout(2.0)
+            hello = c.recv_msg()
+            c.set_timeout(None)
+            cid = int(hello.get("clientID", -1)) \
+                if isinstance(hello, dict) else -1
+            if (not isinstance(hello, dict) or hello.get("q") != SHARD_Q
+                    or hello.get("shard") != self.shard
+                    or not 1 <= cid <= self.num_nodes):
+                raise ProtocolError(f"bad shard hello {hello!r}")
+        except (TimeoutError, ConnectionError, ProtocolError, OSError,
+                ValueError):
+            c.close()
+            return True
+        if self.throttle_bps:
+            c.throttle_bps = self.throttle_bps
+        with self._reg_lock:
+            old = self.conns.get(cid)
+            self.conns[cid] = c
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        return True
+
+    def get_conn(self, cid: int, timeout: float) -> Conn:
+        """The cid's registered shard conn, accepting pending dials until
+        it shows up or the timeout passes (the client dials every shard
+        channel right after its first sharded Enter reply, so the dial
+        is normally already in the listen backlog)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            # drain EVERY pending dial before trusting the registry: a
+            # rejoin's fresh socket may be queued behind the previous
+            # admission's dead one (TCP backlog is FIFO), and returning
+            # the stale registration would serve — and then evict on —
+            # a conn the client already replaced.
+            while self._poll_accept(0.0):
+                pass
+            with self._reg_lock:
+                c = self.conns.get(cid)
+            if c is not None and c.sock.fileno() >= 0:
+                return c
+            wait = deadline - time.monotonic()
+            if wait <= 0:
+                raise TimeoutError(
+                    f"client #{cid} never dialed shard {self.shard}")
+            self._poll_accept(min(wait, 0.1))
+
+    def drop(self, cid: int):
+        with self._reg_lock:
+            c = self.conns.pop(cid, None)
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def drop_if(self, cid: int, conn: Conn) -> bool:
+        """Drop the cid's registration only if it is still ``conn`` —
+        a registration superseded in the meantime belongs to a newer
+        admission and must survive.  True when dropped."""
+        with self._reg_lock:
+            if self.conns.get(cid) is not conn:
+                return False
+            del self.conns[cid]
+        try:
+            conn.close()
+        except OSError:
+            pass
+        return True
+
+    def drop_if_dead(self, cid: int, conn: Conn) -> bool:
+        """``drop_if``, but only when conn's peer is already gone (EOF
+        pending).  MSG_PEEK keeps any real payload intact, so a live
+        conn with a request in flight is never judged dead.  One-shot:
+        a FIN still in flight makes this return False — callers that
+        must not leak a dying socket have to poll."""
+        import socket as _socket
+        try:
+            r, _, _ = select.select([conn.sock], [], [], 0)
+            if r and conn.sock.recv(1, _socket.MSG_PEEK) == b"":
+                return self.drop_if(cid, conn)
+        except OSError:
+            return self.drop_if(cid, conn)
+        return False
+
+    def close(self):
+        with self._reg_lock:
+            conns, self.conns = list(self.conns.values()), {}
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.server.close()
+
+
 def _leaves(tree: PyTree) -> list[np.ndarray]:
     return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
 
@@ -127,8 +318,13 @@ class AsyncEAServer:
 
     def __init__(self, host: str, port: int, num_nodes: int,
                  with_tester: bool = False, accept_timeout: float = 120.0,
-                 handshake_timeout: float | None = 30.0):
+                 handshake_timeout: float | None = 30.0, shards: int = 1,
+                 throttle_bps: float | None = None):
         self.num_nodes = num_nodes
+        self.shards = max(1, int(shards))
+        # emulated-link pacing applied to every conn this server accepts
+        # (bench/chip-free harnesses; None = full loopback speed)
+        self.throttle_bps = throttle_bps
         # Per-handshake IO timeout on the dedicated channels: a client that
         # dies or hangs mid-sync (after Enter?) must not wedge the serve loop
         # — it gets EVICTED and the server keeps serving the others.  The
@@ -152,12 +348,35 @@ class AsyncEAServer:
         # Test channel on port+numNodes+1 (EASGD_server.lua:69-70).
         self.test_server = Server(host, port + num_nodes + 1) \
             if with_tester else None
+        # Shard channels (stripes 1..S-1; stripe 0 rides the dedicated
+        # conns) listen above the test channel: port+numNodes+2+(s-1).
+        # Effective stripe count waits for init_server (it depends on the
+        # leaf list); extra endpoints just never get advertised.
+        self.shard_endpoints = [
+            _ShardEndpoint(host, port + num_nodes + 2 + i, i + 1, num_nodes,
+                           throttle_bps=throttle_bps)
+            for i in range(self.shards - 1)]
+        self.stripes: list[tuple[int, int]] | None = None
+        # per-leaf split counts + the VIRTUAL leaf list (oversized leaves
+        # cut into flat chunk views) the stripe ranges index — see
+        # wire.plan_splits; real-leaf (shape, dtype) kept for validation
+        # and for stitching snapshots back together
+        self.splits: list[int] | None = None
+        self._vcenter: list[np.ndarray] | None = None
+        self._leaf_meta: list[tuple[tuple, Any]] | None = None
+        self._shard_spec: dict | None = None
+        # whether each client negotiated the sharded sync this admission
+        self._shard_cid: dict[int, bool] = {}
         self.broadcast.accept(num_nodes, timeout=accept_timeout)
         self.dedicated: list[Conn] = []
         for s in self.dedicated_servers:
             self.dedicated.append(s.accept(1, timeout=accept_timeout)[0])
         self.test_conn = self.test_server.accept(1, timeout=accept_timeout)[0] \
             if with_tester else None
+        if throttle_bps:
+            for c in (self.broadcast.conns + self.dedicated
+                      + ([self.test_conn] if self.test_conn else [])):
+                c.throttle_bps = throttle_bps
         self.center: list[np.ndarray] | None = None
         self.current_client: int | None = None
         # Telemetry handles (obs.NULL when DISTLEARN_OBS=0) resolve once
@@ -176,11 +395,37 @@ class AsyncEAServer:
         self._h_apply = obs.histogram(
             "async_ea_center_apply_seconds",
             "center += delta apply time (host or device path)")
+        self._c_shard_syncs = obs.counter(
+            "async_ea_shard_syncs_total",
+            "stripe legs completed (sharded syncs only), by shard",
+            labels=("shard",))
+        self._c_shard_bytes = obs.counter(
+            "async_ea_shard_wire_bytes_total",
+            "wire bytes a stripe leg moved (center down + delta up), "
+            "by shard", labels=("shard",))
+        self._h_shard_apply = obs.histogram(
+            "async_ea_shard_apply_seconds",
+            "per-stripe center apply time, by shard", labels=("shard",))
 
     def init_server(self, params: PyTree):
         """Clone params as center, broadcast it to every client
         (ref lua :150-160)."""
         self.center = [x.copy() for x in _leaves(params)]
+        self._leaf_meta = [(tuple(t.shape), t.dtype) for t in self.center]
+        self.splits = wire.plan_splits([t.nbytes for t in self.center],
+                                       [t.size for t in self.center],
+                                       self.shards)
+        self._vcenter = wire.split_views(self.center, self.splits)
+        self.stripes = wire.plan_stripes([v.nbytes for v in self._vcenter],
+                                         self.shards)
+        if len(self.stripes) > 1:
+            self._shard_spec = {
+                "v": SHARD_V, "n": len(self.stripes),
+                "ports": [ep.port for ep in
+                          self.shard_endpoints[:len(self.stripes) - 1]],
+                "stripes": [[lo, hi] for lo, hi in self.stripes],
+                "splits": [[i, p] for i, p in enumerate(self.splits)
+                           if p > 1]}
         for conn in self.broadcast.conns:
             try:
                 # per-leaf 'T' frames: the initial broadcast happens BEFORE
@@ -194,20 +439,26 @@ class AsyncEAServer:
                 print_server(f"initial broadcast to a client failed: {e!r}")
                 conn.close()
 
-    def _check_delta(self, deltas: list[np.ndarray]):
+    def _check_delta(self, deltas: list[np.ndarray],
+                     center: list[np.ndarray] | None = None):
         """Reject a structurally wrong delta BEFORE any leaf is applied, so
         the center never takes a torn update (a mismatched client config
         becomes an eviction, not a corrupted center).  Dtype skew is config
         skew too: an int or f64 delta of the right shape must not be
-        silently cast into the center (ADVICE r3)."""
-        for t, d in zip(self.center, deltas):
-            if tuple(d.shape) != tuple(t.shape):
+        silently cast into the center (ADVICE r3).  ``center`` narrows the
+        check to one stripe's (virtual) slice; the default checks a
+        whole-tree delta against the REAL leaf layout recorded at init —
+        the published center list may be the virtual chunk view."""
+        meta = ([(tuple(t.shape), t.dtype) for t in center]
+                if center is not None else self._leaf_meta)
+        for (shape, dtype), d in zip(meta, deltas):
+            if tuple(d.shape) != shape:
                 raise ProtocolError(
                     f"delta leaf shape {tuple(d.shape)} != center "
-                    f"{tuple(t.shape)} — client/server model config skew")
-            if d.dtype != t.dtype:
+                    f"{shape} — client/server model config skew")
+            if d.dtype != dtype:
                 raise ProtocolError(
-                    f"delta leaf dtype {d.dtype} != center {t.dtype} — "
+                    f"delta leaf dtype {d.dtype} != center {dtype} — "
                     "client/server model config skew")
 
     def _apply_delta(self, deltas: list[np.ndarray]):
@@ -223,9 +474,92 @@ class AsyncEAServer:
         if self._obs_on:
             self._h_apply.observe(time.perf_counter() - t0)
 
+    # -- sharded serving -----------------------------------------------------
+    def _enter_reply(self, cid: int, want: str):
+        """The admission reply for one client: the legacy plain string, or
+        the dict form carrying the wire agreement plus — for clients that
+        negotiated sharding — the explicit stripe plan."""
+        codec = self._wire_cid.get(cid)
+        if codec is None:
+            return want
+        reply: dict[str, Any] = {"a": want,
+                                 "wire": {"v": wire.WIRE_V, "codec": codec}}
+        if self._shard_cid.get(cid):
+            reply["shard"] = self._shard_spec
+        return reply
+
+    def _stripe_center(self, lo: int, hi: int) -> list[np.ndarray]:
+        """VIRTUAL center leaves [lo, hi) to stream for one stripe leg
+        (concurrent server overrides with its atomic snapshot's slice)."""
+        return self._vcenter[lo:hi]
+
+    def _serve_stripe_leg(self, conn: Conn, idx: int,
+                          codec: str) -> list[np.ndarray]:
+        """One stripe's half of a sharded sync on an admitted client's
+        channel: ``Center?`` -> center slice down, ``delta?`` -> delta
+        slice up, validated.  Returns the received delta slice (the
+        caller applies it — serial and concurrent appliers differ)."""
+        lo, hi = self.stripes[idx]
+        b0 = conn.bytes_sent + conn.bytes_received
+        center = self._stripe_center(lo, hi)
+        _expect(conn, CENTER_Q)
+        conn.send_tensors(center, codec=codec, packed=True)
+        _expect(conn, DELTA_Q)
+        conn.send_msg(DELTA)
+        dl = (None if self.handshake_timeout is None
+              else time.monotonic() + self.handshake_timeout)
+        deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
+        self._check_delta(deltas, center=center)
+        self._c_shard_syncs.labels(shard=idx).inc()
+        self._c_shard_bytes.labels(shard=idx).inc(
+            conn.bytes_sent + conn.bytes_received - b0)
+        return deltas
+
+    def _apply_stripe(self, idx: int, deltas: list[np.ndarray]):
+        """Fold one validated stripe's delta into its center slice.
+        Atomicity is per stripe: a client dying mid-sync may land a
+        subset of stripes, each complete-or-nothing — the stale-update
+        asynchrony EASGD already tolerates (arXiv:1412.6651 §4)."""
+        lo, hi = self.stripes[idx]
+        t0 = time.perf_counter() if self._obs_on else 0.0
+        for t, d in zip(self._vcenter[lo:hi], deltas):
+            t += d          # disjoint element ranges (chunk views of a
+            #                 split leaf included): threads never collide
+        if self._obs_on:
+            self._h_shard_apply.labels(shard=idx).observe(
+                time.perf_counter() - t0)
+
+    def _count_sync(self):
+        """One full client sync completed on the sharded path (counted
+        once per sync, not per stripe leg)."""
+        self._c_syncs.inc()
+
+    def _serve_striped(self, cid: int, conn: Conn):
+        """Serve every stripe of one sharded sync.  Stripe 0 rides the
+        dedicated channel on the calling thread; stripes 1.. run on
+        transient threads against their shard endpoints, so one client's
+        legs pipeline.  Any leg failure re-raises (after all legs settle)
+        into the caller's eviction handling; completed stripes stay
+        applied (see ``_apply_stripe``)."""
+        codec = self._wire_cid[cid]
+
+        def leg(idx):
+            if idx == 0:
+                c = conn
+            else:
+                ep = self.shard_endpoints[idx - 1]
+                c = ep.get_conn(cid,
+                                timeout=self.handshake_timeout or 30.0)
+                c.set_timeout(self.handshake_timeout)
+            self._apply_stripe(idx, self._serve_stripe_leg(c, idx, codec))
+
+        _fanout([lambda i=i: leg(i) for i in range(len(self.stripes))])
+        self._count_sync()
+
     def _evict(self, cid: int, why: Exception):
-        """Drop a dead/hung client: close both its channels so recv_any stops
-        selecting it; remaining clients keep syncing."""
+        """Drop a dead/hung client: close all its channels (broadcast,
+        dedicated, every shard) so recv_any stops selecting it and stripe
+        legs fail fast; remaining clients keep syncing."""
         self.evicted.add(cid)
         self._c_evict.inc()
         print_server(f"evicting client #{cid}: {why!r}")
@@ -233,6 +567,8 @@ class AsyncEAServer:
             self.dedicated[cid - 1].close()
         except OSError:
             pass
+        for ep in self.shard_endpoints:
+            ep.drop(cid)
         idx = self._cid_to_broadcast.get(cid)
         if idx is not None:
             try:
@@ -287,8 +623,14 @@ class AsyncEAServer:
                     1, timeout=self.handshake_timeout or 30.0)
             except (TimeoutError, OSError):
                 return
+            if self.throttle_bps:
+                new[0].throttle_bps = self.throttle_bps
+            # speak-by measured from the accept's RETURN — a deadline off
+            # the pre-accept poll timestamp silently shortened the grace
+            # by however long the accept itself took
             self._rejoin_pending.append(
-                (new[0], now + (self.handshake_timeout or 30.0)))
+                (new[0], time.monotonic()
+                 + (self.handshake_timeout or 30.0)))
 
     def _prune_broadcast(self):
         """Closed broadcast conns accumulate forever once rejoin dials
@@ -365,6 +707,8 @@ class AsyncEAServer:
             except OSError:
                 pass
             return
+        if self.throttle_bps:
+            new.throttle_bps = self.throttle_bps
         try:
             with obs.span("async_ea.rejoin", cid=cid):
                 new.set_timeout(self.handshake_timeout)
@@ -373,11 +717,14 @@ class AsyncEAServer:
                     new.send_msg({"a": REJOIN, "wire": {"error": wire_err}})
                     raise ProtocolError(wire_err)
                 self._wire_cid[cid] = codec
-                if codec is not None:
-                    new.send_msg({"a": REJOIN,
-                                  "wire": {"v": wire.WIRE_V, "codec": codec}})
-                else:
-                    new.send_msg(REJOIN)
+                self._shard_cid[cid] = (isinstance(msg.get("shard"), dict)
+                                        and codec is not None
+                                        and self._shard_spec is not None)
+                new.send_msg(self._enter_reply(cid, REJOIN))
+                # rejoin streams the FULL center over the fresh dedicated
+                # conn regardless of sharding (rejoins are rare; the
+                # client re-dials its shard channels afterwards, so every
+                # stripe is resynced by construction)
                 new.send_tensors(self._rejoin_center(),
                                  codec=codec or "raw", packed=codec is not None)
                 _expect(new, ACK)
@@ -432,6 +779,12 @@ class AsyncEAServer:
             self._reject_wire(cid, wire_err)
             return None
         self._wire_cid[cid] = codec
+        # sharding requires the packed wire AND a multi-stripe plan; a
+        # client that advertised against an unsharded server (or without
+        # a codec) just gets no "shard" key back and stays single-stripe
+        self._shard_cid[cid] = (isinstance(msg.get("shard"), dict)
+                                and codec is not None
+                                and self._shard_spec is not None)
         return cid
 
     def _reject_wire(self, cid: int, err: str):
@@ -499,48 +852,52 @@ class AsyncEAServer:
             conn = self.dedicated[cid - 1]  # 1-based ids (ref)
             t0 = time.perf_counter() if self._obs_on else 0.0
             codec = self._wire_cid.get(cid)
+            deltas = None
             try:
                 with obs.span("async_ea.handshake", cid=cid):
                     conn.set_timeout(self.handshake_timeout)
-                    if codec is not None:
-                        conn.send_msg({"a": ENTER,
-                                       "wire": {"v": wire.WIRE_V,
-                                                "codec": codec}})
-                    else:
-                        conn.send_msg(ENTER)
+                    conn.send_msg(self._enter_reply(cid, ENTER))
                     print_server(f"current client is #{self.current_client}")
 
-                    # serverSendCenter (lua :180-196): ONE packed frame on
-                    # a negotiated wire, per-leaf 'T' frames for legacy
-                    _expect(conn, CENTER_Q)
-                    conn.send_tensors(self.center, codec=codec or "raw",
-                                      packed=codec is not None)
+                    if self._shard_cid.get(cid):
+                        # striped sync: every leg validates and applies its
+                        # own slice inside (per-stripe atomicity)
+                        self._serve_striped(cid, conn)
+                        conn.set_timeout(None)
+                    else:
+                        # serverSendCenter (lua :180-196): ONE packed frame
+                        # on a negotiated wire, per-leaf 'T' frames for
+                        # legacy
+                        _expect(conn, CENTER_Q)
+                        conn.send_tensors(self.center, codec=codec or "raw",
+                                          packed=codec is not None)
 
-                    # serverGetUpdateDiff (lua :198-228): receive the FULL
-                    # delta before applying any of it, so an eviction
-                    # mid-stream leaves the center untouched.  The monotonic
-                    # deadline covers the WHOLE delta stream: a client
-                    # trickling payload bytes re-arms the kernel timeout
-                    # forever, the exact wedge the frame deadline closes for
-                    # control frames.
-                    _expect(conn, DELTA_Q)
-                    conn.send_msg(DELTA)
-                    dl = (None if self.handshake_timeout is None
-                          else time.monotonic() + self.handshake_timeout)
-                    # auto-detects packed vs per-leaf, so a legacy client
-                    # needs no branch here; quantized deltas decode into
-                    # fresh center-dtype arrays
-                    deltas = conn.recv_tensors(n=len(self.center),
-                                               deadline=dl)
-                    self._check_delta(deltas)
-                    conn.set_timeout(None)
+                        # serverGetUpdateDiff (lua :198-228): receive the
+                        # FULL delta before applying any of it, so an
+                        # eviction mid-stream leaves the center untouched.
+                        # The monotonic deadline covers the WHOLE delta
+                        # stream: a client trickling payload bytes re-arms
+                        # the kernel timeout forever, the exact wedge the
+                        # frame deadline closes for control frames.
+                        _expect(conn, DELTA_Q)
+                        conn.send_msg(DELTA)
+                        dl = (None if self.handshake_timeout is None
+                              else time.monotonic() + self.handshake_timeout)
+                        # auto-detects packed vs per-leaf, so a legacy
+                        # client needs no branch here; quantized deltas
+                        # decode into fresh center-dtype arrays
+                        deltas = conn.recv_tensors(n=len(self.center),
+                                                   deadline=dl)
+                        self._check_delta(deltas)
+                        conn.set_timeout(None)
             except (TimeoutError, ConnectionError, ProtocolError, OSError,
                     ValueError) as e:   # ValueError: undecodable JSON frame
                 self._evict(cid, e)
                 continue
             if self._obs_on:
                 self._h_handshake.observe(time.perf_counter() - t0)
-            self._apply_delta(deltas)
+            if deltas is not None:
+                self._apply_delta(deltas)
             print_server(f"received delta from client #{self.current_client}")
             return _rebuild(params, [t.copy() for t in self.center])
 
@@ -585,6 +942,8 @@ class AsyncEAServer:
         self.broadcast.close()
         for s in self.dedicated_servers:
             s.close()
+        for ep in self.shard_endpoints:
+            ep.close()
         if self.test_server:
             self.test_server.close()
 
@@ -619,10 +978,12 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     def __init__(self, host: str, port: int, num_nodes: int,
                  with_tester: bool = False, accept_timeout: float = 120.0,
                  handshake_timeout: float | None = 30.0,
-                 pin_device=None, rejoin_grace: float = 10.0):
+                 pin_device=None, rejoin_grace: float = 10.0,
+                 shards: int = 1, throttle_bps: float | None = None):
         super().__init__(host, port, num_nodes, with_tester=with_tester,
                          accept_timeout=accept_timeout,
-                         handshake_timeout=handshake_timeout)
+                         handshake_timeout=handshake_timeout,
+                         shards=shards, throttle_bps=throttle_bps)
         # How long the dispatcher keeps polling for a Rejoin? after every
         # broadcast conn has closed WHILE somebody is evicted — bounded so
         # a permanently-dead evictee cannot hold up shutdown/drained.
@@ -636,6 +997,20 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         # list under self._lock in O(1)
         self._apply_lock = threading.Lock()
         self._queues = [queue.Queue() for _ in range(num_nodes)]
+        # (cid, stripe) -> token queue for the stripe workers (stripes
+        # 1..S-1; stripe 0 rides the main worker), filled in start()
+        self._shard_queues: dict[tuple[int, int], Any] = {}
+        # per-stripe applier locks (host path): slice updates on different
+        # stripes must not serialize behind one _apply_lock.  Kept in a
+        # list so each stripe's lock is its own node; sized in init_server
+        # once the stripe plan exists.
+        self._stripe_locks: list = []
+        # per-client connection generation (ADVICE r5 stale-token race):
+        # bumped on every eviction AND every readmit under self._lock;
+        # queue tokens carry the generation they were issued against and
+        # workers discard mismatches — a token from before an evict/rejoin
+        # cycle must never drive a handshake on the fresh connection
+        self._conn_gen = [0] * num_nodes
         self._threads: list = []
         self._workers: dict[int, Any] = {}
         self._stop = threading.Event()
@@ -661,37 +1036,75 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     # mutating ``current_center``'s result fails loudly instead of
     # corrupting what concurrent workers are streaming.
     def init_server(self, params: PyTree):
+        import threading
         super().init_server(params)
+        self._stripe_locks = [threading.Lock() for _ in self.stripes]
         if self._device is not None:
             self._pin()
         else:
+            if len(self.stripes) > 1:
+                # striped: the PUBLISHED list is the virtual chunk view —
+                # two stripes may own chunks of the same real leaf, and
+                # publishing whole real leaves would let their rebuilds
+                # race (last writer drops the other's chunk).  Real
+                # leaves are stitched back on demand in _snapshot.
+                self.center = self._vcenter
             for t in self.center:
                 t.flags.writeable = False
 
     def _pin(self):
-        """Move the center to the device; build the donated fused apply."""
+        """Move the center to the device; build the donated fused apply.
+        Device leaves mirror the published layout: the VIRTUAL list when
+        striped (chunk slices update independently), real otherwise."""
         self._dev_center = [jax.device_put(t, self._device)
-                            for t in self.center]
+                            for t in self._vcenter]
 
         def _apply(center, deltas):
             return [c + d.astype(c.dtype) for c, d in zip(center, deltas)]
 
         self._dev_apply = jax.jit(_apply, donate_argnums=(0,))
 
-    def _snapshot(self) -> list[np.ndarray]:
+    def _snapshot_v(self) -> list[np.ndarray]:
+        """The published (possibly virtual) leaf list — what stripe legs
+        stream from."""
         with self._lock:
             if self._dev_center is not None:
                 return [np.asarray(jax.device_get(t))
                         for t in self._dev_center]
             return self.center      # immutable published version: no copy
 
+    def _snapshot(self) -> list[np.ndarray]:
+        """REAL-leaf snapshot (tester pushes, rejoin center,
+        ``current_center``): split leaves stitch their chunks back."""
+        leaves = self._snapshot_v()
+        if self.splits is not None and any(p > 1 for p in self.splits):
+            leaves = wire.merge_views(
+                leaves, self.splits,
+                [shape for shape, _ in self._leaf_meta])
+        return leaves
+
     def _apply_delta(self, deltas: list[np.ndarray]):
         t0 = time.perf_counter() if self._obs_on else 0.0
         if self._dev_center is not None:
+            if len(self._stripe_locks) > 1:
+                # device leaves follow the virtual layout when striped
+                deltas = wire.split_views(deltas, self.splits)
             with self._lock:
                 self._dev_center = self._dev_apply(
                     self._dev_center,
                     [jax.device_put(d, self._device) for d in deltas])
+                self._sync_count += 1
+        elif len(self._stripe_locks) > 1:
+            # striped center: route the whole-list delta (legacy clients /
+            # the serial API) through the per-stripe appliers — a
+            # whole-list rebuild-and-swap here would lose a concurrent
+            # sharded client's slice publish.  The wire carried REAL
+            # leaves; re-cut them to the virtual layout the stripes index.
+            vdeltas = wire.split_views(deltas, self.splits)
+            with self._apply_lock:   # whole-list appliers stay ordered
+                for idx, (lo, hi) in enumerate(self.stripes):
+                    self._apply_stripe(idx, vdeltas[lo:hi])
+            with self._lock:
                 self._sync_count += 1
         else:
             with self._apply_lock:  # appliers serialize; readers do not wait
@@ -704,6 +1117,43 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         self._c_syncs.inc()
         if self._obs_on:
             self._h_apply.observe(time.perf_counter() - t0)
+
+    def _stripe_center(self, lo: int, hi: int) -> list[np.ndarray]:
+        return self._snapshot_v()[lo:hi]
+
+    def _apply_stripe(self, idx: int, deltas: list[np.ndarray]):
+        """Slice apply with immutable publish: build fresh read-only
+        leaves for the stripe under ITS lock (appliers on different
+        stripes run concurrently — the tentpole's point), then swap them
+        into a copy of the published list under the pointer lock, so
+        snapshot readers stay O(1) and never see a torn slice."""
+        lo, hi = self.stripes[idx]
+        t0 = time.perf_counter() if self._obs_on else 0.0
+        if self._dev_center is not None:
+            put = [jax.device_put(d, self._device) for d in deltas]
+            with self._lock:
+                self._dev_center[lo:hi] = self._dev_apply(
+                    self._dev_center[lo:hi], put)
+        else:
+            stripe_locks = self._stripe_locks
+            with stripe_locks[idx]:
+                # entries [lo, hi) only change under this stripe's lock,
+                # so reading them outside the pointer lock is stable
+                new = [t + d for t, d in zip(self.center[lo:hi], deltas)]
+                for t in new:
+                    t.flags.writeable = False
+                with self._lock:
+                    pub = list(self.center)
+                    pub[lo:hi] = new
+                    self.center = pub
+        if self._obs_on:
+            self._h_shard_apply.labels(shard=idx).observe(
+                time.perf_counter() - t0)
+
+    def _count_sync(self):
+        with self._lock:
+            self._sync_count += 1
+        self._c_syncs.inc()
 
     @property
     def syncs_completed(self) -> int:
@@ -722,7 +1172,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             return False
         with self._lock:
             inflight = self._inflight
-        return inflight == 0 and all(q.empty() for q in self._queues)
+        return (inflight == 0 and all(q.empty() for q in self._queues)
+                and all(q.empty() for q in self._shard_queues.values()))
 
     def current_center(self, params: PyTree) -> PyTree:
         """Snapshot of the center as a pytree shaped like ``params``."""
@@ -753,17 +1204,28 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         """Eviction body; caller holds ``self._lock`` (the worker's
         stale-conn check needs check+evict ATOMIC against a concurrent
         rejoin's state flip — two separate acquisitions let a rejoin land
-        in between and get its fresh conn closed by a stale decision)."""
+        in between and get its fresh conn closed by a stale decision).
+        Idempotent per eviction cycle: a sharded sync fails on every leg
+        at once (the first leg's eviction closes the other legs' conns),
+        and only the FIRST decision may bump the generation, count, and
+        drain — the dispatcher cannot enqueue for an evicted cid, so
+        there is nothing new to drain on re-entry."""
+        if cid in self.evicted:
+            return
         import queue as _q
+        self._conn_gen[cid - 1] += 1    # stale tokens die at the worker
         super()._evict(cid, why)
-        while True:
-            try:
-                token = self._queues[cid - 1].get_nowait()
-            except _q.Empty:
-                break
-            if token is not None:     # the None stop sentinel never
-                self._inflight -= 1   # incremented _inflight
-                self._g_inflight.dec()
+        for q in ([self._queues[cid - 1]]
+                  + [sq for (qcid, _), sq in self._shard_queues.items()
+                     if qcid == cid]):
+            while True:
+                try:
+                    token = q.get_nowait()
+                except _q.Empty:
+                    break
+                if token is not None:     # the None stop sentinel never
+                    self._inflight -= 1   # incremented _inflight
+                    self._g_inflight.dec()
 
     # -- threads -------------------------------------------------------------
     def _health(self) -> dict:
@@ -775,8 +1237,15 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 "drained": self.drained}
 
     def start(self):
-        """Spawn the dispatcher + one worker per client.  Returns self."""
+        """Spawn the dispatcher, one main worker per client, and — when
+        the center is striped — one stripe worker per (client, stripe>0).
+        Returns self."""
+        import queue
         import threading
+        if self.shards > 1 and self.stripes is None:
+            raise RuntimeError(
+                "init_server must run before start on a sharded server: "
+                "the stripe plan sizes the stripe workers")
         obs.set_health_source(self._health)
         self._threads = [threading.Thread(target=self._dispatch, daemon=True)]
         self._workers = {
@@ -784,6 +1253,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                                   daemon=True)
             for cid in range(1, self.num_nodes + 1)}
         self._threads += list(self._workers.values())
+        if self.stripes is not None and len(self.stripes) > 1:
+            for cid in range(1, self.num_nodes + 1):
+                for idx in range(1, len(self.stripes)):
+                    self._shard_queues[(cid, idx)] = queue.Queue()
+                    self._threads.append(threading.Thread(
+                        target=self._shard_worker, args=(cid, idx),
+                        daemon=True))
         for t in self._threads:
             t.start()
         return self
@@ -791,6 +1267,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     def stop(self):
         self._stop.set()
         for q in self._queues:
+            q.put(None)
+        for q in self._shard_queues.values():
             q.put(None)
         for t in self._threads:
             t.join(timeout=10.0)
@@ -832,6 +1310,10 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         under it."""
         import threading
         with self._lock:
+            # fresh connection, fresh generation: tokens issued against
+            # the pre-eviction conn still in flight anywhere must not
+            # drive a handshake on this one
+            self._conn_gen[cid - 1] += 1
             super()._finish_readmit(cid, idx, conn)
             # a worker that self-evicted DEREGISTERED itself in the same
             # lock hold as its eviction, so presence here means parked
@@ -862,7 +1344,20 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # is terminal (all clients finished) — dispatch is done.
                 # With an evicted client a Rejoin? can still arrive on
                 # the listening socket: poll for one for a bounded grace
-                # before giving up.
+                # before giving up.  But judge evictions only AFTER any
+                # in-flight handshake settles: a client crashing with a
+                # clean FIN closes its broadcast conn (seen here first)
+                # while its worker is still mid-handshake on the other
+                # channels — returning on the instantaneous empty
+                # ``evicted`` would kill dispatch moments before that
+                # worker's eviction lands, making rejoin impossible.
+                deadline = time.monotonic() + (self.handshake_timeout
+                                               or 30.0)
+                while time.monotonic() < deadline and not self.evicted:
+                    with self._lock:
+                        if self._inflight == 0:
+                            break
+                    time.sleep(0.01)
                 if not self.evicted or not self._rejoin_grace_poll():
                     return
                 continue
@@ -889,9 +1384,18 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # unlocked check — enqueueing now would leak the token
                 if cid in self.evicted:
                     continue
-                self._inflight += 1     # token issued; worker will settle it
-                self._g_inflight.inc()
-                self._queues[cid - 1].put(ENTER)
+                # tokens carry the connection generation they were issued
+                # against; every leg settles its own _inflight slot
+                gen = self._conn_gen[cid - 1]
+                sharded = (self._shard_cid.get(cid, False)
+                           and bool(self._shard_queues))
+                n_legs = len(self.stripes) if sharded else 1
+                self._inflight += n_legs
+                self._g_inflight.inc(n_legs)
+                self._queues[cid - 1].put(gen)
+                if sharded:
+                    for idx in range(1, len(self.stripes)):
+                        self._shard_queues[(cid, idx)].put(gen)
 
     def _worker(self, cid: int):
         bufs = None     # reusable delta recv buffers (host path): no 100 MB
@@ -902,47 +1406,66 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 return
             # re-read per token: a rejoin swaps the dedicated conn while
             # this thread is parked on the queue (dispatcher-side
-            # evictions never unpark it)
-            conn = self.dedicated[cid - 1]
-            codec = self._wire_cid.get(cid)
+            # evictions never unpark it).  The generation check rides the
+            # same lock hold so conn/codec/sharded are all from the same
+            # connection epoch as the token.
+            with self._lock:
+                stale = token != self._conn_gen[cid - 1]
+                conn = self.dedicated[cid - 1]
+                codec = self._wire_cid.get(cid)
+                sharded = self._shard_cid.get(cid, False)
+                if stale:
+                    self._inflight -= 1
+                    self._g_inflight.dec()
+            if stale:
+                continue
             t0 = time.perf_counter() if self._obs_on else 0.0
             try:
                 try:
                     with obs.span("async_ea.handshake", cid=cid):
                         conn.set_timeout(self.handshake_timeout)
-                        if codec is not None:
-                            conn.send_msg({"a": ENTER,
-                                           "wire": {"v": wire.WIRE_V,
-                                                    "codec": codec}})
+                        conn.send_msg(self._enter_reply(cid, ENTER))
+                        if sharded:
+                            # stripe 0 only — stripes 1.. run on their own
+                            # workers against the shard endpoints,
+                            # concurrently with this leg
+                            deltas = self._serve_stripe_leg(conn, 0, codec)
+                            conn.set_timeout(None)
                         else:
-                            conn.send_msg(ENTER)
-                        _expect(conn, CENTER_Q)
-                        # stream OUTSIDE the lock; one packed frame on a
-                        # negotiated wire
-                        conn.send_tensors(self._snapshot(),
-                                          codec=codec or "raw",
-                                          packed=codec is not None)
-                        _expect(conn, DELTA_Q)
-                        conn.send_msg(DELTA)
-                        # whole-delta-stream deadline: see sync_server
-                        dl = (None if self.handshake_timeout is None
-                              else time.monotonic() + self.handshake_timeout)
-                        if self._dev_center is None:
-                            if bufs is None:
-                                bufs = [np.empty_like(t)
-                                        for t in self.center]
-                            # recv_tensors(out=...) itself rejects shape/
-                            # dtype skew (ProtocolError -> eviction below)
-                            # and auto-detects packed vs per-leaf frames
-                            deltas = conn.recv_tensors(out=bufs, deadline=dl)
-                        else:
-                            deltas = conn.recv_tensors(n=len(self.center),
-                                                       deadline=dl)
-                        self._check_delta(deltas)   # before ANY apply: a
-                        # config-skewed client is an eviction, never a torn
-                        # or silently-dead worker (the serve loop polls
-                        # drained)
-                        conn.set_timeout(None)
+                            _expect(conn, CENTER_Q)
+                            # stream OUTSIDE the lock; one packed frame on
+                            # a negotiated wire
+                            conn.send_tensors(self._snapshot(),
+                                              codec=codec or "raw",
+                                              packed=codec is not None)
+                            _expect(conn, DELTA_Q)
+                            conn.send_msg(DELTA)
+                            # whole-delta-stream deadline: see sync_server
+                            dl = (None if self.handshake_timeout is None
+                                  else time.monotonic()
+                                  + self.handshake_timeout)
+                            if self._dev_center is None:
+                                if bufs is None:
+                                    # REAL leaf layout: a legacy client's
+                                    # delta is per-leaf whatever the
+                                    # published (virtual) center looks like
+                                    bufs = [np.empty(shape, dtype)
+                                            for shape, dtype
+                                            in self._leaf_meta]
+                                # recv_tensors(out=...) itself rejects
+                                # shape/dtype skew (ProtocolError ->
+                                # eviction below) and auto-detects packed
+                                # vs per-leaf frames
+                                deltas = conn.recv_tensors(out=bufs,
+                                                           deadline=dl)
+                            else:
+                                deltas = conn.recv_tensors(
+                                    n=len(self._leaf_meta), deadline=dl)
+                            self._check_delta(deltas)   # before ANY apply:
+                            # a config-skewed client is an eviction, never
+                            # a torn or silently-dead worker (the serve
+                            # loop polls drained)
+                            conn.set_timeout(None)
                 except (TimeoutError, ConnectionError, ProtocolError,
                         OSError, ValueError) as e:
                     # only evict if OUR conn is still the client's current
@@ -964,7 +1487,85 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     continue                   # stale-conn failure: park
                 if self._obs_on:
                     self._h_handshake.observe(time.perf_counter() - t0)
-                self._apply_delta(deltas)      # full delta only, atomically
+                if sharded:
+                    self._apply_stripe(0, deltas)
+                    self._count_sync()
+                else:
+                    self._apply_delta(deltas)  # full delta only, atomically
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._g_inflight.dec()
+
+    def _shard_worker(self, cid: int, idx: int):
+        """Serve stripe ``idx`` (>= 1) of one client's syncs, forever.
+
+        Unlike the main worker this thread never exits on eviction: tokens
+        are generation-stamped, so anything enqueued before an eviction or
+        rejoin is discarded here by a cheap integer compare, and the
+        thread simply parks for the client's next admission.  That keeps
+        the rejoin path free of (num_shards - 1) respawn bookkeeping."""
+        ep = self.shard_endpoints[idx - 1]
+        while not self._stop.is_set():
+            token = self._shard_queues[(cid, idx)].get()
+            if token is None:
+                return
+            with self._lock:
+                stale = token != self._conn_gen[cid - 1]
+                codec = self._wire_cid.get(cid)
+            try:
+                if stale:
+                    continue
+                conn = None
+                try:
+                    conn = ep.get_conn(cid,
+                                       timeout=self.handshake_timeout or 30.0)
+                    with self._lock:
+                        superseded = token != self._conn_gen[cid - 1]
+                    if superseded:
+                        # superseded while we waited for the dial (an
+                        # eviction raced past us): don't serve or judge
+                        # the registered conn on a stale token.  If it is
+                        # the DEAD admission's socket resurrected from
+                        # the listen backlog after the eviction sweep,
+                        # reap it — and since its FIN may still be in
+                        # flight (the dying client closes its channels
+                        # one by one), park as a reaper, polling until
+                        # it dies, is superseded by a fresh dial, or the
+                        # next admission's token takes over.
+                        q = self._shard_queues[(cid, idx)]
+                        while (not self._stop.is_set() and q.empty()
+                               and ep.conns.get(cid) is conn):
+                            if ep.drop_if_dead(cid, conn):
+                                break
+                            time.sleep(0.05)
+                        continue
+                    conn.set_timeout(self.handshake_timeout)
+                    deltas = self._serve_stripe_leg(conn, idx, codec)
+                    conn.set_timeout(None)
+                except (TimeoutError, ConnectionError, ProtocolError,
+                        OSError, ValueError) as e:
+                    # the conn we just failed on is dead: if it is still
+                    # the registered channel, drop it NO MATTER the
+                    # generation — a leg that registered it after the
+                    # first leg's eviction swept the endpoints would
+                    # otherwise leak it (the identity check keeps a conn
+                    # a rejoin already superseded safe).  Evict only on a
+                    # current-generation token: a stale leg tripping over
+                    # a socket from a superseded admission must never
+                    # evict the re-admitted client.  _evict_locked is
+                    # idempotent, so every stripe leg of a dead client
+                    # reporting at once is fine.
+                    with self._lock:
+                        registered = (conn is not None
+                                      and ep.conns.get(cid) is conn)
+                        if registered:
+                            ep.drop(cid)
+                        if (token == self._conn_gen[cid - 1]
+                                and (conn is None or registered)):
+                            self._evict_locked(cid, e)
+                    continue
+                self._apply_stripe(idx, deltas)
             finally:
                 with self._lock:
                     self._inflight -= 1
@@ -1053,7 +1654,8 @@ class AsyncEAClient:
 
     def __init__(self, host: str, port: int, node: int, tau: int,
                  alpha: float, codec: str | None = "raw",
-                 overlap: bool = False):
+                 overlap: bool = False, sharded: bool = True,
+                 throttle_bps: float | None = None):
         if node < 1:
             raise ValueError("node is 1-based (reference convention)")
         if codec is not None and codec not in wire.CODECS:
@@ -1063,18 +1665,33 @@ class AsyncEAClient:
         self.tau = int(tau)
         self.alpha = float(alpha)
         self.codec = codec
+        # sharded=True merely ADVERTISES the capability (alongside the wire
+        # codec); the server decides whether to stripe.  False pins the
+        # single-channel sync even against a sharded server.
+        self.sharded = bool(sharded) and codec is not None
+        self.throttle_bps = throttle_bps
         self.step = 0
         self.host, self.port = host, port
         # clientBroadcast -> port; dedicated client -> port+node
         # (EASGD_client.lua:58-61).
         self.broadcast = connect(host, port)
         self.conn = connect(host, port + node)
+        if throttle_bps:
+            self.conn.throttle_bps = throttle_bps
         self.center: list[np.ndarray] | None = None
         # None until the first handshake; False pins legacy once a plain-
         # string reply proves the server predates the packed wire
         self._packed: bool | None = None
         self._residuals: list[np.ndarray] | None = None
         self._sender = _DeltaSender() if overlap else None
+        # stripe plan pinned from the first sharded Enter reply; conns to
+        # shard endpoints (stripes 1..S-1 — stripe 0 rides self.conn).
+        # _splits is the per-leaf chunk table the stripe ranges index
+        # (sub-leaf striping: wire.plan_splits / wire.split_views).
+        self._shard_spec: dict | None = None
+        self._stripes: list[tuple[int, int]] | None = None
+        self._splits: list[int] | None = None
+        self._shard_conns: list[Conn] = []
 
     def _announce(self, q: str, want: str) -> bool:
         """Send an admission request (with the wire advertisement unless a
@@ -1084,6 +1701,8 @@ class AsyncEAClient:
         msg: dict[str, Any] = {"q": q, "clientID": self.node}
         if adv:
             msg["wire"] = {"v": wire.WIRE_V, "codec": self.codec}
+            if self.sharded:
+                msg["shard"] = {"v": SHARD_V}
         self.broadcast.send_msg(msg)
         reply = self.conn.recv_msg()
         if not adv:
@@ -1092,7 +1711,76 @@ class AsyncEAClient:
                     f"protocol desync: expected {want!r}, got {reply!r}")
             return False
         self._packed = _check_wire_reply(reply, want, self.codec)
+        if self.sharded and self._packed:
+            self._apply_shard_spec(reply.get("shard"))
         return self._packed
+
+    def _apply_shard_spec(self, spec) -> None:
+        """Adopt (first sight) or re-verify the server's stripe plan from a
+        sharded Enter/Rejoin reply.  On first sight, validate the plan and
+        dial + hello every shard endpoint; the plan is then PINNED — a
+        server that changes or drops it mid-stream is a protocol error,
+        not something to silently re-stripe against (the error-feedback
+        residuals are laid out per-stripe)."""
+        if self._shard_spec is not None:
+            if spec != self._shard_spec:
+                raise ProtocolError(
+                    f"shard plan changed mid-stream: pinned "
+                    f"{self._shard_spec!r}, server now says {spec!r}")
+            return
+        if spec is None:
+            return                          # unsharded (or legacy) server
+        ok = (isinstance(spec, dict) and spec.get("v") == SHARD_V
+              and isinstance(spec.get("ports"), list)
+              and isinstance(spec.get("stripes"), list)
+              and isinstance(spec.get("splits", []), list))
+        splits = [1] * len(self.center or [])
+        if ok:
+            stripes = [tuple(s) for s in spec["stripes"]]
+            n = spec.get("n")
+            ok = (n == len(stripes) and n == len(spec["ports"]) + 1
+                  and n >= 2 and stripes[0][0] == 0
+                  and all(len(s) == 2 and s[0] < s[1] for s in stripes)
+                  and all(stripes[i][1] == stripes[i + 1][0]
+                          for i in range(n - 1)))
+        if ok:
+            # the split table: sparse [leaf_index, parts] rows cutting
+            # oversized leaves into flat chunks — stripe ranges index the
+            # resulting virtual list, so the cover check is against it
+            last = -1
+            for row in spec.get("splits", []):
+                ok = (ok and isinstance(row, (list, tuple))
+                      and len(row) == 2
+                      and all(isinstance(v, int) for v in row)
+                      and last < row[0] < len(splits) and row[1] >= 2
+                      and row[1] <= int(self.center[row[0]].size or 0))
+                if not ok:
+                    break
+                splits[row[0]] = row[1]
+                last = row[0]
+            ok = ok and stripes[-1][1] == len(splits) + sum(
+                p - 1 for p in splits)
+        if not ok:
+            raise ProtocolError(f"malformed shard plan {spec!r}")
+        conns = []
+        try:
+            for s, port in enumerate(spec["ports"], start=1):
+                c = connect(self.host, port)
+                if self.throttle_bps:
+                    c.throttle_bps = self.throttle_bps
+                c.send_msg({"q": SHARD_Q, "clientID": self.node, "shard": s})
+                conns.append(c)
+        except (ConnectionError, OSError):
+            for c in conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            raise
+        self._shard_spec = spec
+        self._stripes = stripes
+        self._splits = splits
+        self._shard_conns = conns
 
     def init_client(self, params: PyTree) -> PyTree:
         """Receive the initial center from the server's broadcast; params :=
@@ -1117,11 +1805,36 @@ class AsyncEAClient:
         # clientEnterSync (lua :82-92)
         print_client(self.node, "waiting to sync")
         packed = self._announce(ENTER_Q, ENTER)
+        striped = packed and self._stripes is not None
+        vcenter = None
+        if striped:
+            # the virtual (sub-leaf split) list the stripe ranges index —
+            # views into the same center buffers, rebuilt per sync so a
+            # rejoin's fresh buffers are always the ones written into
+            vcenter = wire.split_views(self.center, self._splits)
+            if self._stripes[-1][1] != len(vcenter):
+                raise ProtocolError(
+                    f"shard plan covers {self._stripes[-1][1]} virtual "
+                    f"leaves, center splits to {len(vcenter)}")
         # clientGetCenter (lua :95-106): one packed frame (negotiated) or
         # per-leaf, auto-detected — either way into the preallocated
-        # center buffers
-        self.conn.send_msg(CENTER_Q)
-        self.center = self.conn.recv_tensors(out=self.center)
+        # center buffers.  Striped: one Center? leg per stripe, fanned out
+        # so stripe i's decode overlaps stripe i+1's receive (stripe 0 on
+        # the dedicated conn — identical to the unsharded fetch).
+        if striped:
+            conns = [self.conn] + self._shard_conns
+
+            def _fetch(i):
+                lo, hi = self._stripes[i]
+                conns[i].send_msg(CENTER_Q)
+                # chunk views write through into the real center leaves
+                conns[i].recv_tensors(out=vcenter[lo:hi])
+
+            _fanout([lambda i=i: _fetch(i)
+                     for i in range(len(self._stripes))])
+        else:
+            self.conn.send_msg(CENTER_Q)
+            self.center = self.conn.recv_tensors(out=self.center)
         # calculateUpdateDiff (lua :109-119): local EA math.  The scale is
         # folded in-place into the one (p - c) temporary — at 100 MB-leaf
         # scale a second full-size allocation per leaf is measurable on the
@@ -1137,32 +1850,44 @@ class AsyncEAClient:
             d *= np.asarray(self.alpha, d.dtype)
             deltas.append(d)
         new_leaves = [p - d for p, d in zip(leaves, deltas)]
-        payload = None
+        payloads = None
         if packed:
-            if self.codec != "raw":
-                # error feedback (Seide et al. 2014): quantize delta +
-                # carried residual, keep the quantization error for the
-                # next round — without it the bias accumulates and
-                # quantized-EA walks away from the fp32 fixed point
-                if (self._residuals is None
-                        or len(self._residuals) != len(deltas)):
-                    self._residuals = [np.zeros_like(d) for d in deltas]
-                for d, r in zip(deltas, self._residuals):
-                    d += r
-                payload = wire.encode_leaves(deltas, self.codec)
-                for r, d, dec in zip(self._residuals, deltas,
-                                     payload.decoded()):
-                    np.subtract(d, dec, out=r)
-            else:
-                payload = wire.encode_leaves(deltas, "raw")
+            if (self.codec != "raw"
+                    and (self._residuals is None
+                         or len(self._residuals) != len(deltas))):
+                # full-length residual list allocated BEFORE striping so a
+                # stripe's slice aliases the same per-leaf arrays whatever
+                # the plan — see _encode_stripe
+                self._residuals = [np.zeros_like(d) for d in deltas]
+            # striped: encode over the VIRTUAL lists (chunk views of the
+            # same delta/residual arrays), matching the server's layout
+            enc_deltas, enc_res = deltas, self._residuals
+            if striped:
+                enc_deltas = wire.split_views(deltas, self._splits)
+                if self._residuals is not None:
+                    enc_res = wire.split_views(self._residuals,
+                                               self._splits)
+            bounds = self._stripes if striped else [(0, len(enc_deltas))]
+            payloads = [self._encode_stripe(enc_deltas, enc_res, lo, hi)
+                        for lo, hi in bounds]
         # clientSendDiff (lua :122-132)
         conn = self.conn
 
         def _push_delta():
+            if striped:
+                conns = [conn] + self._shard_conns
+
+                def _push(i):
+                    conns[i].send_msg(DELTA_Q)
+                    _expect(conns[i], DELTA)
+                    conns[i].send_packed(payloads[i])
+
+                _fanout([lambda i=i: _push(i) for i in range(len(payloads))])
+                return
             conn.send_msg(DELTA_Q)
             _expect(conn, DELTA)
-            if payload is not None:
-                conn.send_packed(payload)
+            if payloads is not None:
+                conn.send_packed(payloads[0])
             else:
                 for d in deltas:
                     conn.send_tensor(d)
@@ -1175,6 +1900,28 @@ class AsyncEAClient:
             _push_delta()
         print_client(self.node, "synced")
         return _rebuild(params, new_leaves), True
+
+    def _encode_stripe(self, deltas: list[np.ndarray],
+                       residuals: list[np.ndarray] | None,
+                       lo: int, hi: int):
+        """Encode one stripe's delta slice for the packed wire.  Error
+        feedback (Seide et al. 2014) for lossy codecs: quantize delta +
+        carried residual, keep the quantization error for the next round —
+        without it the bias accumulates and quantized-EA walks away from
+        the fp32 fixed point.  ``deltas``/``residuals`` are the lists the
+        stripe plan indexes (the virtual chunk views when striped) —
+        residual chunks view the full-length per-leaf arrays, so
+        per-stripe state stays exact under any plan."""
+        sl = deltas[lo:hi]
+        if self.codec == "raw":
+            return wire.encode_leaves(sl, "raw")
+        res = residuals[lo:hi]
+        for d, r in zip(sl, res):
+            d += r
+        payload = wire.encode_leaves(sl, self.codec)
+        for r, d, dec in zip(res, sl, payload.decoded()):
+            np.subtract(d, dec, out=r)
+        return payload
 
     def rejoin(self, params: PyTree, retries: int = 60,
                retry_interval: float = 0.25,
@@ -1196,17 +1943,27 @@ class AsyncEAClient:
         # the center we quantized against is gone; carrying a residual
         # across an eviction would re-inject error from a stale round
         self._residuals = None
-        for c in (self.broadcast, self.conn):
+        for c in (self.broadcast, self.conn, *self._shard_conns):
             try:
                 c.close()
             except OSError:
                 pass
+        # unpin the stripe plan: the Rejoin reply re-advertises it and
+        # _apply_shard_spec re-dials every shard endpoint (the server
+        # dropped our old shard conns at eviction), so every stripe is
+        # freshly resynced by construction
+        self._shard_spec = None
+        self._stripes = None
+        self._splits = None
+        self._shard_conns = []
         # dedicated BEFORE the Rejoin? announce: the server completes the
         # handshake by accepting on port+node and must find us dialed in
         self.broadcast = connect(self.host, self.port, retries=retries,
                                  retry_interval=retry_interval)
         self.conn = connect(self.host, self.port + self.node,
                             retries=retries, retry_interval=retry_interval)
+        if self.throttle_bps:
+            self.conn.throttle_bps = self.throttle_bps
         # bounded: a server that never re-admits (e.g. this client was
         # transport-dropped without an eviction record) must surface a
         # TimeoutError here, not wedge the worker forever
@@ -1228,6 +1985,11 @@ class AsyncEAClient:
             self._sender.close()
         self.broadcast.close()
         self.conn.close()
+        for c in self._shard_conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class AsyncEATester:

@@ -95,6 +95,10 @@ ASYNC_FLAGS = {
                          "legacy (per-leaf frames, pre-packed peers)"),
     "overlapSync": (False, "overlap local steps with the delta transmit "
                            "(background sender, depth-1 queue)"),
+    "shards": (1, "server: stripe the center across this many shard "
+                  "channels (clients sync stripes in parallel); "
+                  "client: 0 opts out of sharded syncs even when the "
+                  "server advertises a stripe plan (see docs/PERF.md)"),
 }
 
 OBS_FLAGS = {

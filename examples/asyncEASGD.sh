@@ -23,6 +23,9 @@ common="--numNodes $NODES --port $PORT --numEpochs $EPOCHS --batchSize $BATCH \
 # CONCURRENT=1 serves clients on overlapped worker threads
 # (AsyncEAServerConcurrent) instead of the reference's critical section
 SERVER_FLAGS=${CONCURRENT:+--concurrent}
+# SHARDS=N stripes the center across N shard channels (docs/PERF.md);
+# clients negotiate the plan in the Enter? handshake automatically
+SERVER_FLAGS="$SERVER_FLAGS ${SHARDS:+--shards $SHARDS}"
 
 python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS $SERVER_FLAGS &
 SERVER=$!

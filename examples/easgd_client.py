@@ -45,9 +45,14 @@ def main():
         opt, partition=opt.nodeIndex - 1, partitions=opt.numNodes)
 
     codec = None if opt.wireCodec == "legacy" else opt.wireCodec
+    # --shards 0 opts this client out of striped syncs (it still joins a
+    # sharded server — the Enter reply simply omits the stripe plan and
+    # the sync runs on the dedicated conn alone); any other value lets
+    # the server's advertised plan decide.
     client = AsyncEAClient(opt.host, opt.port, node=opt.nodeIndex,
                            tau=opt.communicationTime, alpha=opt.alpha,
-                           codec=codec, overlap=opt.overlapSync)
+                           codec=codec, overlap=opt.overlapSync,
+                           sharded=opt.shards != 0)
     params = client.init_client(params)
 
     @jax.jit

@@ -58,7 +58,8 @@ def main():
     if opt.concurrent:
         import time as _time
         srv = AsyncEAServerConcurrent(opt.host, opt.port, opt.numNodes,
-                                      with_tester=opt.tester)
+                                      with_tester=opt.tester,
+                                      shards=max(1, opt.shards))
         srv.init_server(params)
         srv.start()
         tests_pushed = last_ckpt = last_done = 0
@@ -106,7 +107,7 @@ def main():
         return
 
     srv = AsyncEAServer(opt.host, opt.port, opt.numNodes,
-                        with_tester=opt.tester)
+                        with_tester=opt.tester, shards=max(1, opt.shards))
     srv.init_server(params)
     served = 0
     for i in range(1, num_syncs + 1):

@@ -357,6 +357,12 @@ def main():
         if opt.pp or opt.zero or opt.fsdp:
             raise SystemExit("--generate needs a single-replica param "
                              "layout (not --pp/--zero/--fsdp)")
+        if opt.tp > 1 or opt.sp > 1:
+            # the TP/SP-sharded train step leaves each device holding a
+            # projection/sequence shard; the greedy decoder indexes the
+            # full tree on one replica and would decode from a slice
+            raise SystemExit("--generate needs --tp 1 --sp 1 (the "
+                             "defaults are 2 — pass them explicitly)")
         if opt.moeExperts:
             raise SystemExit("--generate supports dense models (per-tick "
                              "MoE routing would not match the trained "

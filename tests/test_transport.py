@@ -209,6 +209,62 @@ def test_mid_frame_fin_raises_reset_not_clean_eof():
     rx.close()
 
 
+def test_clean_fin_is_peer_closed_type():
+    """Drop-policy code classifies a clean FIN by TYPE — isinstance of
+    PeerClosed — not by matching the exception's message string (which
+    drifted between the Python and native receive paths).  A mid-frame
+    FIN must NOT be PeerClosed: it is the reset subclass."""
+    import struct as _struct
+
+    from distlearn_tpu.comm import PeerClosed
+    from distlearn_tpu.comm.errors import PeerClosed as PeerClosed2
+
+    assert PeerClosed is PeerClosed2          # one canonical class
+    assert issubclass(PeerClosed, ConnectionError)
+
+    # clean FIN on a frame boundary -> PeerClosed, whichever recv path
+    tx, rx = _pair()
+    tx.close()
+    try:
+        rx.recv_msg()
+        raise AssertionError("expected PeerClosed")
+    except ConnectionError as e:
+        assert isinstance(e, PeerClosed), e
+        assert not isinstance(e, ConnectionResetError)
+    rx.close()
+
+    # FIN mid-frame -> reset, and NOT PeerClosed
+    tx, rx = _pair()
+    tx.sock.sendall(_struct.pack("<BQ", ord("J"), 64)[:5])
+    tx.close()
+    try:
+        rx.recv_msg()
+        raise AssertionError("expected ConnectionResetError")
+    except ConnectionError as e:
+        assert isinstance(e, ConnectionResetError), e
+        assert not isinstance(e, PeerClosed)
+    rx.close()
+
+
+def test_recv_any_classifies_clean_fin_without_on_drop_callback():
+    """Server.recv_any treats a PeerClosed as a finished peer (silent
+    drop, no on_drop eviction) while keeping other conns served."""
+    srv = Server("127.0.0.1", 0)
+    quitter = connect("127.0.0.1", srv.port)
+    good = connect("127.0.0.1", srv.port)
+    srv.accept(2, timeout=5.0)
+    quitter.close()                           # clean FIN, nothing sent
+    time.sleep(0.1)
+    dropped = []
+    t = threading.Timer(0.3, lambda: good.send_msg({"q": "hi"}))
+    t.start()
+    _, msg = srv.recv_any(timeout=10.0,
+                          on_drop=lambda i, e: dropped.append((i, e)))
+    assert msg == {"q": "hi"}
+    assert dropped == []                      # clean exit is not a drop
+    t.join(); good.close(); srv.close()
+
+
 def test_trickling_peer_cut_by_frame_deadline():
     """frame_timeout must bound the WHOLE frame read: a peer trickling one
     byte per just-under-timeout interval re-arms a kernel SO_RCVTIMEO on

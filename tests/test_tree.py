@@ -196,5 +196,7 @@ def test_op_timeout_detects_dead_rank():
 
     results = tree_map_spawn(node, 2, timeout=30)
     kind, dt = results[0]
-    assert kind in ("TimeoutError", "ConnectionError"), kind
+    # PeerClosed is the clean-FIN ConnectionError subclass: a dead peer
+    # may be seen either mid-frame (reset/timeout) or between frames
+    assert kind in ("TimeoutError", "ConnectionError", "PeerClosed"), kind
     assert dt < 10.0

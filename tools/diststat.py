@@ -121,6 +121,50 @@ def wire_table(counters: dict) -> dict:
     return dict(sorted(tab.items()))
 
 
+_SHARD_SYNCS = "async_ea_shard_syncs_total"
+_SHARD_BYTES = "async_ea_shard_wire_bytes_total"
+_SHARD_APPLY = "async_ea_shard_apply_seconds"
+
+
+def _shard_label(key: str, fam: str) -> str | None:
+    prefix = fam + '{shard="'
+    if key.startswith(prefix) and key.endswith('"}'):
+        return key[len(prefix):-2]
+    return None
+
+
+def shard_table(counters: dict, histograms: dict) -> dict:
+    """Derive the sharded parameter-server balance table from the
+    async_ea_shard_* families: per shard, stripe legs served, wire bytes
+    moved (center down + delta up) and the per-stripe apply latency.
+    Empty when the run never served a sharded sync — the whole table is
+    the load-balance check for wire.plan_stripes (byte counts should be
+    near-equal across rows; leg counts exactly equal unless a client
+    died mid-sync)."""
+    tab: dict[str, dict] = {}
+
+    def row(shard):
+        return tab.setdefault(shard, {
+            "legs": 0.0, "wire_bytes": 0.0, "applies": 0,
+            "apply_mean": float("nan")})
+
+    for key, v in counters.items():
+        s = _shard_label(key, _SHARD_SYNCS)
+        if s is not None:
+            row(s)["legs"] += v
+        s = _shard_label(key, _SHARD_BYTES)
+        if s is not None:
+            row(s)["wire_bytes"] += v
+    for key, h in histograms.items():
+        s = _shard_label(key, _SHARD_APPLY)
+        if s is not None:
+            r = row(s)
+            r["applies"] += h["count"]
+            r["apply_mean"] = (h["sum"] / h["count"] if h["count"]
+                               else float("nan"))
+    return dict(sorted(tab.items(), key=lambda kv: (len(kv[0]), kv[0])))
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -142,7 +186,8 @@ def summarize_run(paths: list[str]) -> dict:
             "counter_totals": dict(sorted(run["counter_totals"].items())),
             "gauges": dict(sorted(run["gauges"].items())),
             "histograms": hist_tab,
-            "wire": wire_table(run["counters"])}
+            "wire": wire_table(run["counters"]),
+            "shards": shard_table(run["counters"], run["histograms"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -225,6 +270,14 @@ def _print_summary(doc: dict):
             print(f"{codec:<12} {row['frames']:>8g} "
                   f"{row['wire_bytes']:>14g} {row['logical_bytes']:>14g} "
                   f"{row['ratio']:>7.2f}")
+        print()
+    if doc.get("shards"):
+        print(f"{'shard':<8} {'legs':>8} {'wire bytes':>14} "
+              f"{'applies':>9} {'apply mean':>12}")
+        for shard, row in doc["shards"].items():
+            print(f"{shard:<8} {row['legs']:>8g} "
+                  f"{row['wire_bytes']:>14g} {row['applies']:>9g} "
+                  f"{_fmt_s(row['apply_mean']):>12}")
 
 
 def _print_diff(doc: dict):
