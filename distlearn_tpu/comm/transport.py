@@ -21,9 +21,11 @@ pure-Python socket IO.  ``recv_tensor(out=...)`` reuses a preallocated buffer
 
 from __future__ import annotations
 
+import errno
 import itertools
 import json
 import math
+import random
 import select
 import socket
 import struct
@@ -683,13 +685,33 @@ class Server:
         self.sock.close()
 
 
+def _dial_failure_reason(e: OSError) -> str:
+    """Classify a failed dial for the connect-retry counter's `reason`
+    label — lets diststat separate "server not up yet" (refused) from a
+    partitioned/overloaded standby during failover."""
+    if isinstance(e, ConnectionRefusedError):
+        return "refused"
+    if isinstance(e, (TimeoutError, socket.timeout)):
+        return "timeout"
+    if getattr(e, "errno", None) in (errno.EHOSTUNREACH, errno.ENETUNREACH):
+        return "unreachable"
+    return "other"
+
+
 def connect(host: str, port: int, retries: int = 60,
-            retry_interval: float = 0.25) -> Conn:
+            retry_interval: float = 0.25,
+            max_interval: float = 5.0) -> Conn:
     """Client-side connect with retry — the reference launch scripts start
     server and clients concurrently, so clients must tolerate a not-yet-
-    listening server (examples/AsyncEASGD.sh backgrounds everything)."""
+    listening server (examples/AsyncEASGD.sh backgrounds everything).
+
+    Retries back off exponentially from ``retry_interval`` with FULL
+    jitter (sleep ~ U[0, min(max_interval, retry_interval * 2**k)]): a
+    whole fleet failing over to a standby otherwise re-dials in
+    lockstep and thundering-herds the freshly promoted center.
+    """
     last: Exception | None = None
-    for _ in range(retries):
+    for attempt in range(retries):
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
             s.connect((host, port))
@@ -701,6 +723,9 @@ def connect(host: str, port: int, retries: int = 60,
             s.close()
             last = e
             obs.counter("transport_connect_retries_total",
-                        "failed connect() dial attempts").inc()
-            time.sleep(retry_interval)
+                        "failed connect() dial attempts",
+                        labels=("reason",)).labels(
+                            reason=_dial_failure_reason(e)).inc()
+            cap = min(max_interval, retry_interval * (2.0 ** attempt))
+            time.sleep(random.uniform(0.0, cap))
     raise ConnectionError(f"could not connect to {host}:{port}: {last}")

@@ -41,8 +41,9 @@ __all__ = [
     "Op", "send", "recv", "recv_any",
     "tree_allreduce_schedule", "ring_allreduce_schedule",
     "async_ea_sync_schedule", "async_ea_sharded_schedule",
-    "async_ea_rejoin_sharded_schedule", "check_schedules",
-    "lock_order_audit",
+    "async_ea_rejoin_sharded_schedule", "async_ea_failover_schedule",
+    "async_ea_promote_rejoin_schedule", "async_ea_stale_epoch_schedule",
+    "check_schedules", "lock_order_audit",
 ]
 
 
@@ -229,6 +230,128 @@ def async_ea_rejoin_sharded_schedule(num_shards: int = 4) -> dict:
         sched[f"C{s}"] = ([recv("C0", "go"), send(f"S{s}", "Shard?")]
                           + _stripe_leg_client(f"S{s}"))
     return sched
+
+
+def _rejoin_replay_server(c: str, num_shards: int) -> list:
+    """Server half of a Rejoin-with-replay handshake (``_readmit`` +
+    ``_recv_replay``): reply, full center down, Ack up, then the pending
+    delta's un-applied stripe payloads, acked."""
+    return ([recv_any("Rejoin?"), send(c, "Rejoin"),
+             send(c, "center"), recv(c, "ack"),
+             recv(c, "Replay")]
+            + [recv(c, "replay_p")] * num_shards
+            + [send(c, "ack")])
+
+
+def _rejoin_replay_client(s: str, num_shards: int) -> list:
+    """Client half (``_rejoin_handshake`` + ``_replay_exchange``), minus
+    the shard-fanout ``go`` ops the sharded callers splice in."""
+    return ([send(s, "Rejoin?"), recv(s, "Rejoin"),
+             recv(s, "center"), send(s, "ack"),
+             send(s, "Replay")]
+            + [send(s, "replay_p")] * num_shards
+            + [recv(s, "ack")])
+
+
+def async_ea_failover_schedule(num_shards: int = 4, *,
+                               strict: bool = False) -> dict:
+    """Center failover end to end: the primary ``P*`` dies mid-stripe-leg
+    (its serving legs simply STOP — schedules truncated after the center
+    slice goes down), the client's first-sync legs ``C*`` abandon the
+    ruined sync, and the client then fails over to the promoted standby
+    ``T*``: Rejoin with full-stripe replay of the pending delta, then its
+    first clean striped sync (``AsyncEAClient.failover``).
+
+    The ``C*`` recvs from the dead primary are timeout-armed: on the real
+    wire a dead peer surfaces as ``PeerClosed``/ECONNRESET, which aborts
+    the sync attempt exactly like the simulator's timeout abort.
+    ``strict=True`` strips that error surfacing — the expected DL101
+    starvation it produces is the PROOF the failover path needs transport
+    errors to fire, not a crutch hiding a real deadlock."""
+    n = max(2, int(num_shards))
+    to = not strict
+    # the dying primary: Enter handshake completes, every serving leg
+    # pushes its center slice, then the process is gone — no delta recv
+    sched: dict = {"P0": [recv_any("Enter?"), send("C0", "Enter")]
+                   + _stripe_leg_server("C0", True)[:2]}
+    for s in range(1, n):
+        sched[f"P{s}"] = ([recv(f"C{s}", "Shard?", timeout=True)]
+                          + _stripe_leg_server(f"C{s}", True)[:2])
+    sched["C0"] = ([send("P0", "Enter?"), recv("P0", "Enter", timeout=to)]
+                   + [send(f"C{s}", "go") for s in range(1, n)]
+                   + [send("P0", "Center?"),
+                      recv("P0", "center_p", timeout=to),
+                      send("P0", "delta?"), recv("P0", "delta", timeout=to),
+                      send("P0", "delta_p")])
+    for s in range(1, n):
+        sched[f"C{s}"] = [recv(f"C0", "go"), send(f"P{s}", "Shard?"),
+                          send(f"P{s}", "Center?"),
+                          recv(f"P{s}", "center_p", timeout=to),
+                          send(f"P{s}", "delta?"),
+                          recv(f"P{s}", "delta", timeout=to),
+                          send(f"P{s}", "delta_p")]
+    # the promoted standby: Rejoin + replay on the fresh dedicated
+    # channel, then the client's next striped sync — fresh ranks because
+    # failover re-dials everything (new conns, new fanned-out legs)
+    sched["T0"] = (_rejoin_replay_server("F0", n)
+                   + [recv_any("Enter?"), send("F0", "Enter")]
+                   + _stripe_leg_server("F0", False))
+    for s in range(1, n):
+        sched[f"T{s}"] = ([recv(f"F{s}", "Shard?")]
+                          + _stripe_leg_server(f"F{s}", False))
+    # _announce parses the Rejoin reply (re-dialing the shard endpoints)
+    # BEFORE the center streams — hence go-then-center on leg 0
+    cf = _rejoin_replay_client("T0", n)
+    sched["F0"] = (cf[:2]
+                   + [send(f"F{s}", "go") for s in range(1, n)]
+                   + cf[2:]
+                   + [send("T0", "Enter?"), recv("T0", "Enter")]
+                   + _stripe_leg_client("T0"))
+    for s in range(1, n):
+        sched[f"F{s}"] = ([recv("F0", "go"), send(f"T{s}", "Shard?")]
+                          + _stripe_leg_client(f"T{s}"))
+    return sched
+
+
+def async_ea_promote_rejoin_schedule(num_clients: int = 3) -> dict:
+    """The rejoin herd after a promotion: every client of the dead
+    primary re-dials the promoted standby ``S`` at once, each running a
+    Rejoin-with-replay handshake (unsharded: one pending payload).  The
+    serial serve loop admits them one at a time; the schedule proves the
+    herd drains STRICT — no timeout crutch, any ordering bug is a loud
+    DL101/DL104."""
+    k = max(1, int(num_clients))
+    server: list = []
+    for i in range(1, k + 1):
+        server += _rejoin_replay_server(f"C{i}", 1)
+    sched: dict = {"S": server}
+    for i in range(1, k + 1):
+        sched[f"C{i}"] = _rejoin_replay_client("S", 1)
+    return sched
+
+
+def async_ea_stale_epoch_schedule() -> dict:
+    """The zombie fence: a stale center ``Z`` (paused primary back from
+    the dead) answers a client whose epoch is newer with the ``stale``
+    refusal and stops; the client drops ``Z`` from its dial list and runs
+    a clean Rejoin + packed sync against the promoted center ``S``
+    (``_refuse_stale`` / ``StaleCenterError`` -> ``failover``).  Strict —
+    the refusal leg must never leave either side mid-stream."""
+    zombie = [recv_any("Enter?"), send("C", "stale")]
+    promoted = [recv_any("Rejoin?"), send("C", "Rejoin"),
+                send("C", "center"), recv("C", "ack"),
+                recv_any("Enter?"), send("C", "Enter"),
+                recv("C", "Center?"), send("C", "center_p"),
+                recv("C", "delta?"), send("C", "delta"),
+                recv("C", "delta_p")]
+    client = [send("Z", "Enter?"), recv("Z", "stale"),
+              send("S", "Rejoin?"), recv("S", "Rejoin"),
+              recv("S", "center"), send("S", "ack"),
+              send("S", "Enter?"), recv("S", "Enter"),
+              send("S", "Center?"), recv("S", "center_p"),
+              send("S", "delta?"), recv("S", "delta"),
+              send("S", "delta_p")]
+    return {"Z": zombie, "S": promoted, "C": client}
 
 
 # ---------------------------------------------------------------------------
@@ -501,6 +624,15 @@ def lint_comm_protocols(*, num_nodes: int = 7) -> list[Finding]:
     findings += check_schedules(
         async_ea_sharded_schedule(4, server_timeouts=True, truncate_tail=1),
         name="async_ea.evict-mid-stripe")
+    # HA failover (docs/HA.md): primary dying mid-stripe-leg + standby
+    # promotion + replay, the post-promotion rejoin herd, and the stale-
+    # epoch refusal — the latter two strict by construction
+    findings += check_schedules(async_ea_failover_schedule(4),
+                                name="async_ea.failover-promote")
+    findings += check_schedules(async_ea_promote_rejoin_schedule(3),
+                                name="async_ea.promote-rejoin-herd")
+    findings += check_schedules(async_ea_stale_epoch_schedule(),
+                                name="async_ea.stale-epoch-refusal")
     from distlearn_tpu.comm import ring, transport, tree
     from distlearn_tpu.parallel import async_ea
     findings += lock_order_audit([transport, tree, ring, async_ea],

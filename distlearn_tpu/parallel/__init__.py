@@ -5,7 +5,9 @@ from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
 from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
                                              AsyncEAServerConcurrent,
-                                             AsyncEATester)
+                                             AsyncEATester, StaleCenterError)
+from distlearn_tpu.parallel.ha import (StandbyCenter, install_signal_flush,
+                                       promote, restore_center)
 from distlearn_tpu.parallel.sequence import (ring_attention, local_attention,
                                              alltoall_attention)
 from distlearn_tpu.parallel.pp import pipeline_apply
@@ -24,6 +26,11 @@ __all__ = [
     "AsyncEAServerConcurrent",
     "AsyncEAClient",
     "AsyncEATester",
+    "StaleCenterError",
+    "StandbyCenter",
+    "install_signal_flush",
+    "promote",
+    "restore_center",
     "ring_attention",
     "local_attention",
     "alltoall_attention",

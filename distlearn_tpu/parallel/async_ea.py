@@ -54,9 +54,24 @@ DELTA = "delta"
 TEST_Q = "Test?"
 ACK = "Ack"
 SHARD_Q = "Shard?"
+REPLAY_Q = "Replay"
 
 #: Shard-negotiation schema version (the "shard" key in Enter?/Rejoin?).
 SHARD_V = 1
+
+#: applied-seq sentinel meaning "assume everything was applied" — adopted
+#: when a restored checkpoint's per-stripe seq table cannot be matched to
+#: the current stripe plan (replay degrades to at-most-once, never twice).
+_SEQ_INF = 2 ** 62
+
+
+class StaleCenterError(ProtocolError):
+    """A center answered an admission request with an OLDER epoch than the
+    client has already synced against — the zombie-primary fence
+    (docs/HA.md).  A pre-failover primary coming back from a stall must
+    never serve (or take deltas from) a client that moved on to the
+    promoted standby; the client drops the refusing address from its
+    failover dial list and re-dials."""
 
 # ---------------------------------------------------------------------------
 # Wire negotiation (packed 'P' frames + codecs, comm/wire.py).
@@ -319,7 +334,8 @@ class AsyncEAServer:
     def __init__(self, host: str, port: int, num_nodes: int,
                  with_tester: bool = False, accept_timeout: float = 120.0,
                  handshake_timeout: float | None = 30.0, shards: int = 1,
-                 throttle_bps: float | None = None):
+                 throttle_bps: float | None = None, standby: bool = False):
+        import threading
         self.num_nodes = num_nodes
         self.shards = max(1, int(shards))
         # emulated-link pacing applied to every conn this server accepts
@@ -367,16 +383,47 @@ class AsyncEAServer:
         self._shard_spec: dict | None = None
         # whether each client negotiated the sharded sync this admission
         self._shard_cid: dict[int, bool] = {}
-        self.broadcast.accept(num_nodes, timeout=accept_timeout)
-        self.dedicated: list[Conn] = []
-        for s in self.dedicated_servers:
-            self.dedicated.append(s.accept(1, timeout=accept_timeout)[0])
-        self.test_conn = self.test_server.accept(1, timeout=accept_timeout)[0] \
-            if with_tester else None
-        if throttle_bps:
-            for c in (self.broadcast.conns + self.dedicated
-                      + ([self.test_conn] if self.test_conn else [])):
-                c.throttle_bps = throttle_bps
+        # -- HA state (docs/HA.md) -------------------------------------------
+        # Center epoch: bumped on promotion (adopt_ha_meta) and carried in
+        # every dict admission reply; a client that has seen a NEWER epoch
+        # refuses this center (zombie fence) and vice versa.
+        self.epoch = 0
+        # per-client sync sequence claimed in the latest Enter? (None =
+        # legacy/pre-HA client) and, per stripe, the highest seq whose
+        # delta has been APPLIED — the exactly-once ledger the rejoin
+        # replay consults.  Recorded in the same critical section as the
+        # center publish (see _apply_stripe/_apply_delta overrides).
+        self._sync_seq: dict[int, int | None] = {}
+        self._applied_seq: dict[int, list[int]] = {}
+        # checkpoint plumbing (enable_checkpoint); _ckpt_lock serializes
+        # snapshot+save and is only ever OUTER of the concurrent server's
+        # _lock (DL102: acyclic)
+        self._ckpt = None
+        self._ckpt_every = 1
+        self._ckpt_count = 0
+        self._ckpt_lock = threading.Lock()
+        self._sync_total = 0
+        self._closed = False
+        self._standby = bool(standby)
+        if standby:
+            # Warm standby: no fleet to accept — every cid starts evicted,
+            # so admission happens exclusively through the rejoin path
+            # once this process is promoted (ha.promote / --standby).
+            self.dedicated: list[Conn | None] = [None] * num_nodes
+            self.test_conn = None
+            self.evicted = set(range(1, num_nodes + 1))
+        else:
+            self.broadcast.accept(num_nodes, timeout=accept_timeout)
+            self.dedicated = []
+            for s in self.dedicated_servers:
+                self.dedicated.append(s.accept(1, timeout=accept_timeout)[0])
+            self.test_conn = \
+                self.test_server.accept(1, timeout=accept_timeout)[0] \
+                if with_tester else None
+            if throttle_bps:
+                for c in (self.broadcast.conns + self.dedicated
+                          + ([self.test_conn] if self.test_conn else [])):
+                    c.throttle_bps = throttle_bps
         self.center: list[np.ndarray] | None = None
         self.current_client: int | None = None
         # Telemetry handles (obs.NULL when DISTLEARN_OBS=0) resolve once
@@ -389,6 +436,9 @@ class AsyncEAServer:
             "async_ea_evictions_total", "clients evicted mid-handshake")
         self._c_rejoin = obs.counter(
             "async_ea_rejoins_total", "evicted clients re-admitted")
+        self._c_stale = obs.counter(
+            "async_ea_failover_stale_refusals_total",
+            "admissions refused on the epoch fence (stale/zombie center)")
         self._h_handshake = obs.histogram(
             "async_ea_handshake_seconds",
             "full sync handshake (Enter sent to delta validated)")
@@ -461,15 +511,33 @@ class AsyncEAServer:
                     f"delta leaf dtype {d.dtype} != center {dtype} — "
                     "client/server model config skew")
 
-    def _apply_delta(self, deltas: list[np.ndarray]):
+    def _record_applied(self, cid: int, idx: int, seq: int):
+        """Mark stripe ``idx`` of client ``cid``'s sync ``seq`` as applied
+        (monotonic per stripe).  Callers invoke this in the same critical
+        section that publishes the center slice, so a checkpoint snapshot
+        (center + this ledger, one hold) is mutually consistent and the
+        rejoin replay is exactly-once."""
+        seqs = self._applied_seq.get(cid)
+        if seqs is None:
+            seqs = self._applied_seq[cid] = [0] * len(self.stripes)
+        if seq > seqs[idx]:
+            seqs[idx] = seq
+
+    def _apply_delta(self, deltas: list[np.ndarray],
+                     ha: tuple[int, int] | None = None):
         """Fold a fully-received, validated delta into the center.  The
         serial server mutates in place; the concurrent subclass overrides
         this with its immutable-publish version (so the serial
         ``sync_server`` API keeps working on a concurrent server, whose
-        center leaves are frozen)."""
+        center leaves are frozen).  ``ha=(cid, seq)`` records the apply in
+        the exactly-once ledger (a whole-tree delta covers every stripe)."""
         t0 = time.perf_counter() if self._obs_on else 0.0
         for t, d in zip(self.center, deltas):
             t += d              # dtypes equal (checked) — no astype copy
+        if ha is not None:
+            for idx in range(len(self.stripes)):
+                self._record_applied(ha[0], idx, ha[1])
+        self._sync_total += 1
         self._c_syncs.inc()
         if self._obs_on:
             self._h_apply.observe(time.perf_counter() - t0)
@@ -483,7 +551,8 @@ class AsyncEAServer:
         if codec is None:
             return want
         reply: dict[str, Any] = {"a": want,
-                                 "wire": {"v": wire.WIRE_V, "codec": codec}}
+                                 "wire": {"v": wire.WIRE_V, "codec": codec},
+                                 "epoch": self.epoch}
         if self._shard_cid.get(cid):
             reply["shard"] = self._shard_spec
         return reply
@@ -515,16 +584,21 @@ class AsyncEAServer:
             conn.bytes_sent + conn.bytes_received - b0)
         return deltas
 
-    def _apply_stripe(self, idx: int, deltas: list[np.ndarray]):
+    def _apply_stripe(self, idx: int, deltas: list[np.ndarray],
+                      ha: tuple[int, int] | None = None):
         """Fold one validated stripe's delta into its center slice.
         Atomicity is per stripe: a client dying mid-sync may land a
         subset of stripes, each complete-or-nothing — the stale-update
-        asynchrony EASGD already tolerates (arXiv:1412.6651 §4)."""
+        asynchrony EASGD already tolerates (arXiv:1412.6651 §4).  The
+        exactly-once ledger tracks exactly that per-stripe granularity:
+        ``ha=(cid, seq)`` marks THIS stripe of THAT sync applied."""
         lo, hi = self.stripes[idx]
         t0 = time.perf_counter() if self._obs_on else 0.0
         for t, d in zip(self._vcenter[lo:hi], deltas):
             t += d          # disjoint element ranges (chunk views of a
             #                 split leaf included): threads never collide
+        if ha is not None:
+            self._record_applied(ha[0], idx, ha[1])
         if self._obs_on:
             self._h_shard_apply.labels(shard=idx).observe(
                 time.perf_counter() - t0)
@@ -532,7 +606,15 @@ class AsyncEAServer:
     def _count_sync(self):
         """One full client sync completed on the sharded path (counted
         once per sync, not per stripe leg)."""
+        self._sync_total += 1
         self._c_syncs.inc()
+
+    @property
+    def syncs_completed(self) -> int:
+        """Deltas applied since construction (the concurrent server
+        overrides with its lock-guarded count) — also the checkpoint
+        step counter."""
+        return self._sync_total
 
     def _serve_striped(self, cid: int, conn: Conn):
         """Serve every stripe of one sharded sync.  Stripe 0 rides the
@@ -542,6 +624,8 @@ class AsyncEAServer:
         into the caller's eviction handling; completed stripes stay
         applied (see ``_apply_stripe``)."""
         codec = self._wire_cid[cid]
+        seq = self._sync_seq.get(cid)
+        ha = (cid, seq) if seq is not None else None
 
         def leg(idx):
             if idx == 0:
@@ -551,7 +635,8 @@ class AsyncEAServer:
                 c = ep.get_conn(cid,
                                 timeout=self.handshake_timeout or 30.0)
                 c.set_timeout(self.handshake_timeout)
-            self._apply_stripe(idx, self._serve_stripe_leg(c, idx, codec))
+            self._apply_stripe(idx, self._serve_stripe_leg(c, idx, codec),
+                               ha=ha)
 
         _fanout([lambda i=i: leg(i) for i in range(len(self.stripes))])
         self._count_sync()
@@ -563,10 +648,12 @@ class AsyncEAServer:
         self.evicted.add(cid)
         self._c_evict.inc()
         print_server(f"evicting client #{cid}: {why!r}")
-        try:
-            self.dedicated[cid - 1].close()
-        except OSError:
-            pass
+        conn = self.dedicated[cid - 1]      # None on a never-admitted
+        if conn is not None:                # standby slot
+            try:
+                conn.close()
+            except OSError:
+                pass
         for ep in self.shard_endpoints:
             ep.drop(cid)
         idx = self._cid_to_broadcast.get(cid)
@@ -712,6 +799,16 @@ class AsyncEAServer:
         try:
             with obs.span("async_ea.rejoin", cid=cid):
                 new.set_timeout(self.handshake_timeout)
+                claimed_epoch = msg.get("epoch")
+                if isinstance(claimed_epoch, int) \
+                        and claimed_epoch > self.epoch:
+                    # zombie fence on the rejoin leg (see _refuse_stale)
+                    self._c_stale.inc()
+                    new.send_msg({"a": REJOIN, "stale": True,
+                                  "epoch": self.epoch})
+                    raise ProtocolError(
+                        f"center epoch {self.epoch} is stale: client "
+                        f"#{cid} has synced with epoch {claimed_epoch}")
                 if wire_err is not None:
                     # same loud rejection as _reject_wire, on the rejoin leg
                     new.send_msg({"a": REJOIN, "wire": {"error": wire_err}})
@@ -720,7 +817,23 @@ class AsyncEAServer:
                 self._shard_cid[cid] = (isinstance(msg.get("shard"), dict)
                                         and codec is not None
                                         and self._shard_spec is not None)
-                new.send_msg(self._enter_reply(cid, REJOIN))
+                reply = self._enter_reply(cid, REJOIN)
+                # Exactly-once replay negotiation (docs/HA.md): the client
+                # claims the sequence of its newest un-acked delta; we
+                # answer with the stripes whose ledger entry is older —
+                # the ones the dying center (or this freshly restored one)
+                # never applied.  Lock-free ledger read is safe: the cid
+                # is evicted, so none of its legs are in flight.
+                claimed_seq = msg.get("replay")
+                need: list[int] = []
+                if (isinstance(reply, dict) and isinstance(claimed_seq, int)
+                        and claimed_seq > 0 and self.stripes is not None):
+                    seqs = (self._applied_seq.get(cid)
+                            or [0] * len(self.stripes))
+                    need = [i for i, s in enumerate(seqs)
+                            if s < claimed_seq]
+                    reply["replay"] = {"seq": claimed_seq, "need": need}
+                new.send_msg(reply)
                 # rejoin streams the FULL center over the fresh dedicated
                 # conn regardless of sharding (rejoins are rare; the
                 # client re-dials its shard channels afterwards, so every
@@ -728,6 +841,8 @@ class AsyncEAServer:
                 new.send_tensors(self._rejoin_center(),
                                  codec=codec or "raw", packed=codec is not None)
                 _expect(new, ACK)
+                if need:
+                    self._recv_replay(cid, new, claimed_seq, need)
                 new.set_timeout(None)
         except (TimeoutError, ConnectionError, ProtocolError, OSError,
                 ValueError) as e:
@@ -741,6 +856,33 @@ class AsyncEAServer:
             return
         self._finish_readmit(cid, idx, new)
         print_server(f"client #{cid} re-admitted")
+
+    def _recv_replay(self, cid: int, conn: Conn, seq: int,
+                     need: list[int]):
+        """Receive and apply the replayed stripes of the client's claimed
+        sync ``seq`` (the rejoin reply told it which ones this center's
+        ledger is missing).  The client resends the EXACT encoded payload
+        bytes it stored at encode time, so a restored/promoted center
+        lands bitwise on the same trajectory as an unkilled one; a client
+        that cannot replay (stripe plan changed, payloads gone) sends an
+        abort header and the delta is dropped — the lost stale update
+        EASGD already tolerates (docs/EA_CONVERGENCE.md)."""
+        hdr = conn.recv_msg()
+        if not (isinstance(hdr, dict) and hdr.get("q") == REPLAY_Q):
+            raise ProtocolError(
+                f"protocol desync: expected {REPLAY_Q!r} header, "
+                f"got {hdr!r}")
+        if not hdr.get("abort"):
+            dl = (None if self.handshake_timeout is None
+                  else time.monotonic() + self.handshake_timeout)
+            for i in need:
+                lo, hi = self.stripes[i]
+                deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
+                self._check_delta(deltas,
+                                  center=self._stripe_center(lo, hi))
+                self._apply_stripe(i, deltas, ha=(cid, seq))
+            self._count_sync()
+        conn.send_msg(ACK)
 
     def _parse_cid(self, msg) -> int:
         """The clientID an admission-family message claims, or -1 when
@@ -774,6 +916,10 @@ class AsyncEAServer:
                                  f"{msg.get('clientID')!r}")
             return None
         self._cid_to_broadcast[cid] = idx
+        claimed = msg.get("epoch")
+        if isinstance(claimed, int) and claimed > self.epoch:
+            self._refuse_stale(cid, claimed)
+            return None
         codec, wire_err = _parse_wire_request(msg)
         if wire_err is not None:
             self._reject_wire(cid, wire_err)
@@ -785,6 +931,10 @@ class AsyncEAServer:
         self._shard_cid[cid] = (isinstance(msg.get("shard"), dict)
                                 and codec is not None
                                 and self._shard_spec is not None)
+        # the sync sequence this admission claims (None = pre-HA client):
+        # recorded into the exactly-once ledger when the delta applies
+        seq = msg.get("seq")
+        self._sync_seq[cid] = seq if isinstance(seq, int) else None
         return cid
 
     def _reject_wire(self, cid: int, err: str):
@@ -799,6 +949,25 @@ class AsyncEAServer:
             conn.send_msg({"a": ENTER, "wire": {"error": err}})
         except (TimeoutError, ConnectionError, OSError):
             pass
+        self._evict(cid, ProtocolError(err))
+
+    def _refuse_stale(self, cid: int, claimed: int):
+        """The client has synced against a NEWER center epoch than ours:
+        this process is a zombie (pre-failover) primary.  Answer loudly on
+        the dedicated channel — the client raises ``StaleCenterError`` and
+        drops this address from its dial list — and evict; this center
+        must never stream a center or take a delta from that client."""
+        self._c_stale.inc()
+        err = (f"center epoch {self.epoch} is stale: client #{cid} has "
+               f"synced with epoch {claimed}")
+        conn = self.dedicated[cid - 1]
+        if conn is not None:
+            try:
+                conn.set_timeout(self.handshake_timeout)
+                conn.send_msg({"a": ENTER, "stale": True,
+                               "epoch": self.epoch})
+            except (TimeoutError, ConnectionError, OSError):
+                pass
         self._evict(cid, ProtocolError(err))
 
     def sync_server(self, params: PyTree,
@@ -840,6 +1009,20 @@ class AsyncEAServer:
             except TimeoutError:
                 if deadline is not None and time.monotonic() >= deadline:
                     raise
+                continue
+            except RuntimeError:
+                # recv_any with zero open conns.  For a normal server that
+                # is the documented "fleet finished" stop condition —
+                # re-raise.  A (promoted) standby STARTS with zero conns
+                # and every cid evicted: its whole fleet arrives through
+                # Rejoin? dials, so keep polling _accept_rejoiners.
+                if not (self._standby and self.evicted):
+                    raise
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "no sync request within the timeout (standby "
+                        "still waiting for its fleet to re-dial)")
+                time.sleep(0.05)
                 continue
             self._note_spoke(idx)
             if isinstance(msg, dict) and msg.get("q") == REJOIN_Q:
@@ -897,8 +1080,11 @@ class AsyncEAServer:
             if self._obs_on:
                 self._h_handshake.observe(time.perf_counter() - t0)
             if deltas is not None:
-                self._apply_delta(deltas)
+                seq = self._sync_seq.get(cid)
+                self._apply_delta(
+                    deltas, ha=(cid, seq) if seq is not None else None)
             print_server(f"received delta from client #{self.current_client}")
+            self._maybe_checkpoint()
             return _rebuild(params, [t.copy() for t in self.center])
 
     def test_net(self, tensors: list[np.ndarray] | None = None) -> bool:
@@ -938,7 +1124,130 @@ class AsyncEAServer:
             self.test_conn = None
             return False
 
+    # -- HA: periodic checkpointing + promotion (docs/HA.md) -----------------
+    def enable_checkpoint(self, directory: str, every: int = 1,
+                          keep: int = 3):
+        """Checkpoint the center (plus the HA ledger) to ``directory``
+        every ``every`` applied syncs, keeping the newest ``keep`` files.
+        Uses the bf16-safe ``AsyncCheckpointer`` — the snapshot is taken
+        synchronously (consistent by construction, see ``_ha_state``) and
+        the atomic ``ckpt_{step}.npz`` write happens off-thread.  Returns
+        self so construction chains."""
+        from distlearn_tpu.utils.checkpoint import AsyncCheckpointer
+        self._ckpt = AsyncCheckpointer(directory, keep=keep)
+        self._ckpt_every = max(1, int(every))
+        self._ckpt_count = self.syncs_completed
+        self._c_ckpt_saves = obs.counter(
+            "center_ckpt_saves_total", "center checkpoints written")
+        self._g_ckpt_step = obs.gauge(
+            "center_ckpt_last_step", "sync count of the newest checkpoint")
+        self._h_ckpt_save = obs.histogram(
+            "center_ckpt_save_seconds",
+            "snapshot + save-submit time per center checkpoint")
+        return self
+
+    def _ha_state(self) -> tuple[int, list[np.ndarray], dict]:
+        """(step, REAL center leaves, HA metadata) — one mutually
+        consistent snapshot.  The serial server is single-threaded, so
+        plain reads ARE consistent; the concurrent override grabs the
+        center pointer, ledger, and epoch under one lock hold."""
+        leaves = self._rejoin_center()
+        meta = {"epoch": self.epoch,
+                "applied_seq": {str(c): list(s)
+                                for c, s in self._applied_seq.items()},
+                "wire": {str(c): v for c, v in self._wire_cid.items()},
+                "shards": self.shards,
+                "num_nodes": self.num_nodes}
+        return self.syncs_completed, leaves, meta
+
+    def _checkpoint_locked(self):
+        """Snapshot + save; caller holds ``_ckpt_lock``.  Leaves are keyed
+        ``center/<i>`` in the npz (flat index order — the restore template
+        in ``parallel/ha.py`` mirrors it)."""
+        t0 = time.perf_counter()
+        step, leaves, meta = self._ha_state()
+        self._ckpt.save(step,
+                        {"center": {str(i): t for i, t in enumerate(leaves)}},
+                        metadata=meta)
+        self._ckpt_count = step
+        self._c_ckpt_saves.inc()
+        self._g_ckpt_step.set(step)
+        self._h_ckpt_save.observe(time.perf_counter() - t0)
+
+    def _maybe_checkpoint(self):
+        """Cadence check on the sync path.  Non-blocking: if another
+        thread is mid-checkpoint, skip — the next sync re-checks (the
+        cadence is a floor, not a schedule)."""
+        if self._ckpt is None \
+                or self.syncs_completed - self._ckpt_count < self._ckpt_every:
+            return
+        if not self._ckpt_lock.acquire(blocking=False):
+            return
+        try:
+            if self.syncs_completed - self._ckpt_count >= self._ckpt_every:
+                self._checkpoint_locked()
+        finally:
+            self._ckpt_lock.release()
+
+    def checkpoint_now(self, wait: bool = False):
+        """Unconditional checkpoint (the SIGTERM final flush —
+        ``ha.install_signal_flush``).  ``wait=True`` blocks until the file
+        is durably on disk."""
+        if self._ckpt is None:
+            return
+        with self._ckpt_lock:
+            self._checkpoint_locked()
+        if wait:
+            self._ckpt.wait()
+
+    def adopt_ha_meta(self, meta: dict | None):
+        """Adopt a restored checkpoint's HA metadata and take over as the
+        NEXT center epoch (promotion).  Call after ``init_server`` with
+        the restored center — the stripe plan must exist so the per-cid
+        applied-seq ledgers can be validated against it; a ledger cut for
+        a different plan degrades to the at-most-once sentinel (the
+        replay is skipped, never double-applied)."""
+        meta = meta or {}
+        try:
+            self.epoch = int(meta.get("epoch", 0)) + 1
+        except (TypeError, ValueError):
+            self.epoch = 1
+        # resume the restored sync count: checkpoint filenames are keyed
+        # by it, and a promoted center restarting at 0 would leave the
+        # dead primary's higher-numbered files winning latest_step —
+        # the NEXT promotion would then restore pre-failover state
+        try:
+            self._sync_total = max(self._sync_total,
+                                   int(meta.get("step", 0)))
+        except (TypeError, ValueError):
+            pass
+        self._ckpt_count = self.syncs_completed
+        n = len(self.stripes) if self.stripes else 1
+        for key, val in (meta.get("applied_seq") or {}).items():
+            try:
+                cid = int(key)
+            except (TypeError, ValueError):
+                continue
+            if not 1 <= cid <= self.num_nodes:
+                continue
+            if (isinstance(val, list) and len(val) == n
+                    and all(isinstance(v, int) for v in val)):
+                self._applied_seq[cid] = list(val)
+            else:
+                self._applied_seq[cid] = [_SEQ_INF] * n
+        obs.counter("center_ckpt_restores_total",
+                    "center checkpoints restored (promotions)").inc()
+        return self
+
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._ckpt is not None:
+            try:
+                self._ckpt.wait()   # surface (don't lose) a failed write
+            except Exception as e:  # noqa: BLE001 — close never raises
+                print_server(f"final checkpoint flush failed: {e!r}")
         self.broadcast.close()
         for s in self.dedicated_servers:
             s.close()
@@ -979,11 +1288,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                  with_tester: bool = False, accept_timeout: float = 120.0,
                  handshake_timeout: float | None = 30.0,
                  pin_device=None, rejoin_grace: float = 10.0,
-                 shards: int = 1, throttle_bps: float | None = None):
+                 shards: int = 1, throttle_bps: float | None = None,
+                 standby: bool = False):
         super().__init__(host, port, num_nodes, with_tester=with_tester,
                          accept_timeout=accept_timeout,
                          handshake_timeout=handshake_timeout,
-                         shards=shards, throttle_bps=throttle_bps)
+                         shards=shards, throttle_bps=throttle_bps,
+                         standby=standby)
         # How long the dispatcher keeps polling for a Rejoin? after every
         # broadcast conn has closed WHILE somebody is evicted — bounded so
         # a permanently-dead evictee cannot hold up shutdown/drained.
@@ -1024,6 +1335,11 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         # the dispatcher's view without taking the dispatcher lock
         self._g_inflight = obs.gauge(
             "async_ea_inflight", "sync handshakes currently in flight")
+        # set by start()/stop(); the chaos soak asserts it returns to 0 so
+        # repeated restart cycles provably don't accumulate threads
+        self._g_threads = obs.gauge(
+            "async_ea_server_threads",
+            "live dispatcher/worker threads of this server")
 
     # -- center storage ------------------------------------------------------
     #
@@ -1083,7 +1399,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 [shape for shape, _ in self._leaf_meta])
         return leaves
 
-    def _apply_delta(self, deltas: list[np.ndarray]):
+    def _apply_delta(self, deltas: list[np.ndarray],
+                     ha: tuple[int, int] | None = None):
         t0 = time.perf_counter() if self._obs_on else 0.0
         if self._dev_center is not None:
             if len(self._stripe_locks) > 1:
@@ -1094,6 +1411,9 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     self._dev_center,
                     [jax.device_put(d, self._device) for d in deltas])
                 self._sync_count += 1
+                if ha is not None:      # whole tree = every stripe applied
+                    for idx in range(len(self.stripes)):
+                        self._record_applied(ha[0], idx, ha[1])
         elif len(self._stripe_locks) > 1:
             # striped center: route the whole-list delta (legacy clients /
             # the serial API) through the per-stripe appliers — a
@@ -1103,7 +1423,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             vdeltas = wire.split_views(deltas, self.splits)
             with self._apply_lock:   # whole-list appliers stay ordered
                 for idx, (lo, hi) in enumerate(self.stripes):
-                    self._apply_stripe(idx, vdeltas[lo:hi])
+                    self._apply_stripe(idx, vdeltas[lo:hi], ha=ha)
             with self._lock:
                 self._sync_count += 1
         else:
@@ -1114,6 +1434,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 with self._lock:
                     self.center = new
                     self._sync_count += 1
+                    if ha is not None:
+                        self._record_applied(ha[0], 0, ha[1])
         self._c_syncs.inc()
         if self._obs_on:
             self._h_apply.observe(time.perf_counter() - t0)
@@ -1121,12 +1443,16 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     def _stripe_center(self, lo: int, hi: int) -> list[np.ndarray]:
         return self._snapshot_v()[lo:hi]
 
-    def _apply_stripe(self, idx: int, deltas: list[np.ndarray]):
+    def _apply_stripe(self, idx: int, deltas: list[np.ndarray],
+                      ha: tuple[int, int] | None = None):
         """Slice apply with immutable publish: build fresh read-only
         leaves for the stripe under ITS lock (appliers on different
         stripes run concurrently — the tentpole's point), then swap them
         into a copy of the published list under the pointer lock, so
-        snapshot readers stay O(1) and never see a torn slice."""
+        snapshot readers stay O(1) and never see a torn slice.  The
+        exactly-once ledger entry rides the SAME pointer-lock hold as the
+        publish — a checkpoint snapshot can never see a published slice
+        without its ledger entry or vice versa."""
         lo, hi = self.stripes[idx]
         t0 = time.perf_counter() if self._obs_on else 0.0
         if self._dev_center is not None:
@@ -1134,6 +1460,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             with self._lock:
                 self._dev_center[lo:hi] = self._dev_apply(
                     self._dev_center[lo:hi], put)
+                if ha is not None:
+                    self._record_applied(ha[0], idx, ha[1])
         else:
             stripe_locks = self._stripe_locks
             with stripe_locks[idx]:
@@ -1146,6 +1474,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     pub = list(self.center)
                     pub[lo:hi] = new
                     self.center = pub
+                    if ha is not None:
+                        self._record_applied(ha[0], idx, ha[1])
         if self._obs_on:
             self._h_shard_apply.labels(shard=idx).observe(
                 time.perf_counter() - t0)
@@ -1159,6 +1489,39 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     def syncs_completed(self) -> int:
         with self._lock:
             return self._sync_count
+
+    def adopt_ha_meta(self, meta: dict | None):
+        out = super().adopt_ha_meta(meta)
+        with self._lock:
+            self._sync_count = max(self._sync_count, self._sync_total)
+        self._ckpt_count = self.syncs_completed
+        return out
+
+    def _ha_state(self) -> tuple[int, list[np.ndarray], dict]:
+        """Consistent HA snapshot: center pointer, applied-seq ledger,
+        epoch, and step all under ONE ``_lock`` hold (each apply publishes
+        its slice and its ledger entry in that same hold, so the tuple is
+        mutually consistent by construction — a torn checkpoint taken
+        mid-sync restores and replays only the genuinely missing
+        stripes).  The stitch of split leaves runs outside the lock: the
+        grabbed leaves are immutable published versions."""
+        with self._lock:
+            if self._dev_center is not None:
+                leaves = [np.asarray(jax.device_get(t))
+                          for t in self._dev_center]
+            else:
+                leaves = self.center
+            seqs = {str(c): list(s) for c, s in self._applied_seq.items()}
+            epoch = self.epoch
+            step = self._sync_count
+        if self.splits is not None and any(p > 1 for p in self.splits):
+            leaves = wire.merge_views(
+                leaves, self.splits,
+                [shape for shape, _ in self._leaf_meta])
+        meta = {"epoch": epoch, "applied_seq": seqs,
+                "wire": {str(c): v for c, v in self._wire_cid.items()},
+                "shards": self.shards, "num_nodes": self.num_nodes}
+        return step, leaves, meta
 
     @property
     def drained(self) -> bool:
@@ -1262,16 +1625,47 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                         daemon=True))
         for t in self._threads:
             t.start()
+        self._g_threads.set(len(self._threads))
         return self
 
-    def stop(self):
+    def stop(self, deadline: float = 10.0):
+        """Stop the dispatcher and every worker: sentinel all queues, join
+        with a SHARED deadline across the whole thread set, and — if any
+        thread is still alive (blocked in socket IO past its own timeout)
+        — close the server's sockets so the blocked call fails fast, then
+        join once more.  Repeated start/stop cycles (the chaos soak's
+        kill/promote loop) must not accumulate threads or fds; the
+        surviving count is published on ``async_ea_server_threads`` so the
+        soak can assert it returns to zero."""
         self._stop.set()
         for q in self._queues:
             q.put(None)
         for q in self._shard_queues.values():
             q.put(None)
+        end = time.monotonic() + deadline
         for t in self._threads:
-            t.join(timeout=10.0)
+            t.join(timeout=max(0.0, end - time.monotonic()))
+        if any(t.is_alive() for t in self._threads):
+            # escalation: a thread wedged in recv/accept holds its socket;
+            # closing every listener/conn surfaces an error in the blocked
+            # call and the thread exits through its normal handler
+            self.close()
+            end = time.monotonic() + deadline
+            for t in self._threads:
+                if t.is_alive():
+                    t.join(timeout=max(0.0, end - time.monotonic()))
+        self._threads = [t for t in self._threads if t.is_alive()]
+        self._workers = {cid: t for cid, t in self._workers.items()
+                         if t.is_alive()}
+        if not self._threads:
+            # legs dispatched but never settled die with their workers;
+            # release this server's contribution to the (shared) gauge
+            # or a killed-mid-sync center leaves it stranded nonzero
+            with self._lock:
+                if self._inflight:
+                    self._g_inflight.dec(self._inflight)
+                    self._inflight = 0
+        self._g_threads.set(len(self._threads))
         obs.set_health_source(None)
 
     def _rejoin_grace_poll(self) -> bool:
@@ -1414,6 +1808,11 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 conn = self.dedicated[cid - 1]
                 codec = self._wire_cid.get(cid)
                 sharded = self._shard_cid.get(cid, False)
+                # the claimed seq rides the same hold as conn/codec, so it
+                # is from the same admission as the token — a faster next
+                # admission overwriting _sync_seq cannot skew this sync's
+                # ledger entry
+                seq = self._sync_seq.get(cid)
                 if stale:
                     self._inflight -= 1
                     self._g_inflight.dec()
@@ -1487,11 +1886,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     continue                   # stale-conn failure: park
                 if self._obs_on:
                     self._h_handshake.observe(time.perf_counter() - t0)
+                ha = (cid, seq) if seq is not None else None
                 if sharded:
-                    self._apply_stripe(0, deltas)
+                    self._apply_stripe(0, deltas, ha=ha)
                     self._count_sync()
                 else:
-                    self._apply_delta(deltas)  # full delta only, atomically
+                    self._apply_delta(deltas, ha=ha)  # full delta, atomic
+                self._maybe_checkpoint()
             finally:
                 with self._lock:
                     self._inflight -= 1
@@ -1513,6 +1914,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             with self._lock:
                 stale = token != self._conn_gen[cid - 1]
                 codec = self._wire_cid.get(cid)
+                seq = self._sync_seq.get(cid)   # same hold: same admission
             try:
                 if stale:
                     continue
@@ -1565,7 +1967,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                                 and (conn is None or registered)):
                             self._evict_locked(cid, e)
                     continue
-                self._apply_stripe(idx, deltas)
+                self._apply_stripe(idx, deltas,
+                                   ha=(cid, seq) if seq is not None else None)
             finally:
                 with self._lock:
                     self._inflight -= 1
@@ -1655,7 +2058,8 @@ class AsyncEAClient:
     def __init__(self, host: str, port: int, node: int, tau: int,
                  alpha: float, codec: str | None = "raw",
                  overlap: bool = False, sharded: bool = True,
-                 throttle_bps: float | None = None):
+                 throttle_bps: float | None = None,
+                 centers: list[tuple[str, int]] | None = None):
         if node < 1:
             raise ValueError("node is 1-based (reference convention)")
         if codec is not None and codec not in wire.CODECS:
@@ -1692,6 +2096,32 @@ class AsyncEAClient:
         self._stripes: list[tuple[int, int]] | None = None
         self._splits: list[int] | None = None
         self._shard_conns: list[Conn] = []
+        # -- HA state (docs/HA.md) -------------------------------------------
+        # failover dial list: the primary plus any standby addresses; a
+        # center refusing us on the epoch fence is removed permanently
+        self._centers: list[tuple[str, int]] = [(host, port)] + [
+            (h, int(p)) for h, p in (centers or [])
+            if (h, int(p)) != (host, port)]
+        self._center_i = 0
+        # newest center epoch any reply carried; announced back so a
+        # zombie primary refuses us instead of serving stale state
+        self._seen_epoch: int | None = None
+        # per-sync sequence stamped into Enter?; (_seq, payloads, bounds)
+        # of the newest encoded delta is kept until the next sync so a
+        # failover rejoin can replay the exact bytes (exactly-once)
+        self._seq = 0
+        self._pending: tuple[int, list, list] | None = None
+        self._last_reply: dict | None = None
+        self._c_redials = obs.counter(
+            "async_ea_failover_redials_total",
+            "failover re-dial attempts (per candidate center tried)")
+        self._c_replays = obs.counter(
+            "async_ea_failover_replays_total",
+            "rejoin replay outcomes of the pending delta, by outcome",
+            labels=("outcome",))
+        self._c_stale = obs.counter(
+            "async_ea_failover_stale_refusals_total",
+            "admissions refused on the epoch fence (stale/zombie center)")
 
     def _announce(self, q: str, want: str) -> bool:
         """Send an admission request (with the wire advertisement unless a
@@ -1703,6 +2133,18 @@ class AsyncEAClient:
             msg["wire"] = {"v": wire.WIRE_V, "codec": self.codec}
             if self.sharded:
                 msg["shard"] = {"v": SHARD_V}
+            # epoch fence (docs/HA.md): announce the newest epoch we've
+            # synced against so a demoted/zombie center refuses us loudly
+            # instead of serving state the fleet has moved past
+            if self._seen_epoch is not None:
+                msg["epoch"] = self._seen_epoch
+            if q == ENTER_Q:
+                self._seq += 1
+                msg["seq"] = self._seq
+            elif q == REJOIN_Q and self._pending is not None:
+                # offer the pending delta's seq: the server answers with
+                # which stripes it never applied (exactly-once replay)
+                msg["replay"] = self._pending[0]
         self.broadcast.send_msg(msg)
         reply = self.conn.recv_msg()
         if not adv:
@@ -1710,7 +2152,24 @@ class AsyncEAClient:
                 raise ProtocolError(
                     f"protocol desync: expected {want!r}, got {reply!r}")
             return False
+        if isinstance(reply, dict) and reply.get("stale"):
+            raise StaleCenterError(
+                f"center at {self.host}:{self.port} refused us as stale: "
+                f"its epoch {reply.get('epoch')!r} is behind ours "
+                f"({self._seen_epoch!r})")
         self._packed = _check_wire_reply(reply, want, self.codec)
+        self._last_reply = reply if isinstance(reply, dict) else None
+        if isinstance(reply, dict):
+            ep = reply.get("epoch")
+            if isinstance(ep, int):
+                if self._seen_epoch is not None and ep < self._seen_epoch:
+                    # a center claiming an OLDER epoch than one we've
+                    # synced with is a zombie predating the fence keys
+                    raise StaleCenterError(
+                        f"center at {self.host}:{self.port} serves epoch "
+                        f"{ep}, but we have synced with epoch "
+                        f"{self._seen_epoch}")
+                self._seen_epoch = ep
         if self.sharded and self._packed:
             self._apply_shard_spec(reply.get("shard"))
         return self._packed
@@ -1870,6 +2329,12 @@ class AsyncEAClient:
             bounds = self._stripes if striped else [(0, len(enc_deltas))]
             payloads = [self._encode_stripe(enc_deltas, enc_res, lo, hi)
                         for lo, hi in bounds]
+            # keep the encoded bytes until the next sync: if the center
+            # dies with this delta partially applied, the failover rejoin
+            # replays exactly the stripes the server never saw
+            self._pending = (self._seq, payloads, [tuple(b) for b in bounds])
+        else:
+            self._pending = None
         # clientSendDiff (lua :122-132)
         conn = self.conn
 
@@ -1923,26 +2388,23 @@ class AsyncEAClient:
             np.subtract(d, dec, out=r)
         return payload
 
-    def rejoin(self, params: PyTree, retries: int = 60,
-               retry_interval: float = 0.25,
-               handshake_timeout: float | None = 60.0) -> PyTree:
-        """Recover from an eviction: re-dial both channels, announce
-        ``Rejoin?``, and take the server's CURRENT center as params (the
-        local copy is stale by definition — rejoining with drifted params
-        would push a delta against a center the client never saw).
-
-        The server must be serving (its serve loop accepts rejoiners
-        whenever any client is evicted).  Raises the underlying transport
-        error if the server is gone; safe to call again.  Local state
-        (``step``, ``tau``) is preserved so the sync cadence continues.
-        """
+    def _rejoin_handshake(self, n_leaves: int, retries: int,
+                          retry_interval: float,
+                          handshake_timeout: float | None,
+                          host: str | None = None,
+                          port: int | None = None) -> None:
+        """The shared Rejoin? machinery behind :meth:`rejoin` and
+        :meth:`failover`: tear down every connection, re-dial (optionally
+        a DIFFERENT center), announce ``Rejoin?``, adopt the center, and
+        run the replay exchange for a pending delta."""
+        if host is not None:
+            # _apply_shard_spec dials shard endpoints against self.host,
+            # so the target must be adopted before the announce
+            self.host, self.port = host, port if port is not None else self.port
         if self._sender is not None:
             # wait out (and discard the failure of) any in-flight delta —
             # it was riding the connection being replaced
             self._sender.drain()
-        # the center we quantized against is gone; carrying a residual
-        # across an eviction would re-inject error from a stale round
-        self._residuals = None
         for c in (self.broadcast, self.conn, *self._shard_conns):
             try:
                 c.close()
@@ -1969,16 +2431,130 @@ class AsyncEAClient:
         # TimeoutError here, not wedge the worker forever
         self.conn.set_timeout(handshake_timeout)
         self._announce(REJOIN_Q, REJOIN)
-        leaves = _leaves(params)
         # deadline over the WHOLE center stream: a server stalling
         # mid-tensor must surface here too, not only on control frames
         dl = (None if handshake_timeout is None
               else time.monotonic() + handshake_timeout)
-        self.center = self.conn.recv_tensors(n=len(leaves), deadline=dl)
+        self.center = self.conn.recv_tensors(n=n_leaves, deadline=dl)
         self.conn.send_msg(ACK)
+        self._replay_exchange()
         self.conn.set_timeout(None)
+
+    def _replay_exchange(self) -> None:
+        """After a Rejoin handshake: if the server asked for replay (its
+        Rejoin reply carries ``{"replay": {"seq", "need"}}``), resend the
+        pending stripes it never applied — the exactly-once half of
+        failover.  The pending delta is consumed either way: whatever the
+        outcome, the next sync starts from the adopted center."""
+        info = (self._last_reply or {}).get("replay") \
+            if isinstance(self._last_reply, dict) else None
+        pending, self._pending = self._pending, None
+        if not isinstance(info, dict):
+            if pending is not None:
+                # promoted-from-checkpoint path with no seq record for us,
+                # or a legacy-style reply: the delta is simply lost — EA
+                # absorbs a dropped delta, it must NOT be double-applied
+                self._c_replays.labels(outcome="dropped").inc()
+            return
+        need = info.get("need") or []
+        if not need:
+            self._c_replays.labels(outcome="clean").inc()
+            return
+        seq, payloads, bounds = (pending if pending is not None
+                                 else (None, [], []))
+        # the server's plan for THIS handshake must match the plan the
+        # pending payloads were encoded under, else the bytes land on the
+        # wrong stripe ranges — abort the replay rather than corrupt
+        plan_ok = (pending is not None and info.get("seq") == seq
+                   and all(isinstance(i, int) and 0 <= i < len(payloads)
+                           for i in need))
+        if plan_ok:
+            if self._stripes is not None:
+                plan_ok = bounds == [tuple(s) for s in self._stripes]
+            else:
+                plan_ok = len(bounds) == 1
+        if not plan_ok:
+            self.conn.send_msg({"q": REPLAY_Q, "abort": True})
+            _expect(self.conn, ACK)
+            self._c_replays.labels(outcome="dropped").inc()
+            return
+        self.conn.send_msg({"q": REPLAY_Q, "n": len(need)})
+        for i in need:
+            self.conn.send_packed(payloads[i])
+        _expect(self.conn, ACK)
+        self._c_replays.labels(outcome="replayed").inc()
+
+    def rejoin(self, params: PyTree, retries: int = 60,
+               retry_interval: float = 0.25,
+               handshake_timeout: float | None = 60.0) -> PyTree:
+        """Recover from an eviction: re-dial both channels, announce
+        ``Rejoin?``, and take the server's CURRENT center as params (the
+        local copy is stale by definition — rejoining with drifted params
+        would push a delta against a center the client never saw).
+
+        The server must be serving (its serve loop accepts rejoiners
+        whenever any client is evicted).  Raises the underlying transport
+        error if the server is gone; safe to call again.  Local state
+        (``step``, ``tau``) is preserved so the sync cadence continues.
+        """
+        # the center we quantized against is gone; carrying a residual
+        # across an eviction would re-inject error from a stale round.
+        # (failover() deliberately KEEPS both — see docs/HA.md.)
+        self._residuals = None
+        self._pending = None
+        self._rejoin_handshake(len(_leaves(params)), retries,
+                               retry_interval, handshake_timeout)
         print_client(self.node, "re-admitted")
         return _rebuild(params, [c.copy() for c in self.center])
+
+    def failover(self, params: PyTree, retries: int = 60,
+                 retry_interval: float = 0.25,
+                 handshake_timeout: float | None = 60.0) -> PyTree:
+        """Survive a center death: walk the dial list (primary + standbys)
+        until some center — possibly a freshly promoted standby — admits
+        us through the Rejoin path, replaying the pending delta if asked.
+
+        Unlike :meth:`rejoin`, the LOCAL params and error-feedback
+        residuals are preserved: the promoted center restored from a
+        checkpoint of the same trajectory, so the EASGD staleness bound
+        and the residual error-feedback stream both remain valid
+        (docs/HA.md, docs/EA_CONVERGENCE.md).  A center that refuses us on
+        the epoch fence is removed from the dial list permanently.
+        Returns ``params`` unchanged; raises ``ConnectionError`` when the
+        dial list is exhausted.
+        """
+        n = len(_leaves(params))
+        with obs.span("async_ea.failover", cid=self.node):
+            for _ in range(max(1, int(retries))):
+                if not self._centers:
+                    break
+                host, port = self._centers[self._center_i
+                                           % len(self._centers)]
+                self._c_redials.inc()
+                try:
+                    self._rejoin_handshake(
+                        n, retries=3, retry_interval=retry_interval,
+                        handshake_timeout=handshake_timeout,
+                        host=host, port=port)
+                except StaleCenterError:
+                    # MUST come before ProtocolError (its base class):
+                    # a fenced-off center can never become valid again
+                    self._c_stale.inc()
+                    try:
+                        self._centers.remove((host, port))
+                    except ValueError:
+                        pass
+                    continue
+                except (TimeoutError, ConnectionError, ProtocolError,
+                        OSError):
+                    self._center_i += 1
+                    continue
+                print_client(self.node, "failed over to "
+                             f"{self.host}:{self.port}")
+                return params
+        raise ConnectionError(
+            f"client {self.node}: no center admitted us "
+            f"(dial list: {self._centers!r})")
 
     def close(self):
         if self._sender is not None:

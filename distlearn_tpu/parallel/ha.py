@@ -1,0 +1,173 @@
+"""Center HA: restore/promote helpers around the AsyncEA checkpoint layer.
+
+The server side of failover (docs/HA.md).  ``AsyncEAServer`` writes
+``ckpt_{step}.npz`` files whose tree is ``{"center": {"<i>": leaf}}`` plus
+HA metadata (epoch, per-client applied-seq ledger, negotiated codecs);
+this module turns a directory of those files back into a SERVING center:
+
+* :func:`restore_center` — load the newest (or a specific) checkpoint into
+  the leaf structure of a template pytree.
+* :func:`promote` — restore + ``init_server`` + ``adopt_ha_meta`` on a
+  standby server, bumping the center epoch so the dead primary is fenced.
+* :class:`StandbyCenter` — the warm-standby loop: tail the checkpoint
+  directory, optionally probe the primary, promote on demand.
+* :func:`install_signal_flush` — SIGTERM hook for the final checkpoint
+  flush before the process dies.
+
+Clients need none of this: their half is ``AsyncEAClient.failover`` (walk
+the dial list, rejoin, replay the pending delta).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import time
+from typing import Any, Callable
+
+from distlearn_tpu import obs
+from distlearn_tpu.utils.checkpoint import latest_step, restore_checkpoint
+
+from .async_ea import _leaves, _rebuild
+from distlearn_tpu.utils.logging import print_server
+
+PyTree = Any
+
+
+def _template(like: PyTree) -> dict:
+    """The npz-side tree shape ``_checkpoint_locked`` writes: center
+    leaves keyed by flat index under "center"."""
+    return {"center": {str(i): leaf
+                       for i, leaf in enumerate(_leaves(like))}}
+
+
+def restore_center(directory: str, like: PyTree,
+                   step: int | None = None) -> tuple[PyTree, dict]:
+    """Restore a center checkpoint into the structure of ``like``
+    (shape/dtype validated leaf by leaf; ``step=None`` -> newest).
+    Returns ``(center_pytree, metadata)`` — metadata carries the HA keys
+    ``epoch`` / ``applied_seq`` / ``wire`` for :meth:`adopt_ha_meta`."""
+    tree, meta = restore_checkpoint(directory, _template(like), step=step)
+    got = [tree["center"][str(i)] for i in range(len(_leaves(like)))]
+    return _rebuild(like, got), meta
+
+
+def promote(srv, directory: str, like: PyTree,
+            step: int | None = None) -> PyTree:
+    """Promote ``srv`` (a standby ``AsyncEAServer``/``Concurrent``) to
+    primary: restore the newest center checkpoint, seed the server with
+    it, and adopt the HA metadata — which bumps the epoch past the dead
+    primary's, so the fence refuses anything it might still serve.
+    Returns the restored center pytree (the promoted trajectory's state,
+    e.g. for a tester)."""
+    with obs.span("async_ea.promote", directory=directory):
+        center, meta = restore_center(directory, like, step=step)
+        srv.init_server(center)
+        srv.adopt_ha_meta(meta)
+    obs.counter("async_ea_failover_promotions_total",
+                "standby centers promoted to primary").inc()
+    print_server(f"promoted from {directory} "
+                 f"(step {meta.get('step')}, epoch {srv.epoch})")
+    return center
+
+
+def tcp_probe(host: str, port: int, timeout: float = 1.0) -> bool:
+    """True when something is accepting on (host, port) — the minimal
+    is-the-primary-alive probe for :meth:`StandbyCenter.watch`."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+class StandbyCenter:
+    """The warm-standby loop around a server constructed with
+    ``standby=True`` (listeners bound, no clients awaited): tail the
+    checkpoint directory the primary writes, and :meth:`promote` when told
+    — or when :meth:`watch`'s probe of the primary goes dark.
+
+    The server is NOT serving until promotion; after :meth:`promote` the
+    caller runs the normal serve loop (``sync_server`` / ``start``).
+    """
+
+    def __init__(self, server, directory: str, like: PyTree):
+        self.server = server
+        self.directory = directory
+        self.like = like
+        self.promoted = False
+
+    def poll_step(self) -> int | None:
+        """Newest checkpoint step visible right now (None: none yet)."""
+        return latest_step(self.directory)
+
+    def wait_for_checkpoint(self, timeout: float | None = None,
+                            poll: float = 0.25) -> int:
+        """Block until at least one checkpoint exists; returns its step.
+        Raises ``TimeoutError`` after ``timeout`` seconds."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            step = self.poll_step()
+            if step is not None:
+                return step
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no checkpoint appeared in {self.directory} "
+                    f"within {timeout}s")
+            time.sleep(poll)
+
+    def promote(self, step: int | None = None) -> PyTree:
+        """Restore + take over as the next epoch (see :func:`promote`)."""
+        center = promote(self.server, self.directory, self.like, step=step)
+        self.promoted = True
+        return center
+
+    def watch(self, primary_probe: Callable[[], bool],
+              poll: float = 0.5, ckpt_grace: float = 30.0) -> PyTree:
+        """The standby main loop: re-probe the primary every ``poll``
+        seconds and promote the moment it stops answering (two misses —
+        one could be a restart blip).  Returns the restored center.
+
+        The FIRST probe is deferred until a checkpoint exists: a tcp
+        probe of the primary's protocol port during its startup accept
+        would be counted toward the expected client dials; a visible
+        checkpoint proves startup completed.  (Post-startup probes are
+        safe — a server with nobody evicted leaves unknown dials in the
+        listen backlog, and rejoin-window accepts carry a speak-by
+        deadline.)  ``ckpt_grace`` bounds the wait for a final
+        checkpoint racing in after the primary went dark."""
+        self.wait_for_checkpoint()
+        misses = 0
+        while True:
+            if primary_probe():
+                misses = 0
+            else:
+                misses += 1
+                if misses >= 2:
+                    self.wait_for_checkpoint(timeout=ckpt_grace)
+                    return self.promote()
+            time.sleep(poll)
+
+
+def install_signal_flush(srv, signums=(signal.SIGTERM,)) -> None:
+    """Install a final-flush handler: on each of ``signums``, write one
+    last checkpoint (blocking until durable) then deliver the signal's
+    prior disposition.  A previously installed Python handler is chained;
+    the default disposition is re-delivered via re-raise so exit codes
+    stay honest.  Call from the main thread (signal module rule)."""
+    for signum in signums:
+        prev = signal.getsignal(signum)
+
+        def _flush(num, frame, _prev=prev):
+            try:
+                srv.checkpoint_now(wait=True)
+            except Exception as e:  # noqa: BLE001 — dying anyway
+                print_server(f"final checkpoint flush failed: {e!r}")
+            if callable(_prev):
+                _prev(num, frame)
+            elif _prev is not signal.SIG_IGN:
+                signal.signal(num, signal.SIG_DFL)
+                os.kill(os.getpid(), num)
+
+        signal.signal(signum, _flush)

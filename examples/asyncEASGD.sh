@@ -26,13 +26,41 @@ SERVER_FLAGS=${CONCURRENT:+--concurrent}
 # SHARDS=N stripes the center across N shard channels (docs/PERF.md);
 # clients negotiate the plan in the Enter? handshake automatically
 SERVER_FLAGS="$SERVER_FLAGS ${SHARDS:+--shards $SHARDS}"
+# CENTER_CKPT=dir turns on HA checkpointing of the center (+ one final
+# flush on SIGTERM); CKPT_EVERY tunes the cadence.  STANDBY_PORT=p also
+# launches a warm standby on that port tailing the same directory and
+# points the clients' failover dial list at it (docs/HA.md).
+SERVER_FLAGS="$SERVER_FLAGS ${CENTER_CKPT:+--centerCkpt $CENTER_CKPT}"
+SERVER_FLAGS="$SERVER_FLAGS ${CKPT_EVERY:+--ckptEvery $CKPT_EVERY}"
+CLIENT_FLAGS=${STANDBY_PORT:+--centers 127.0.0.1:$STANDBY_PORT}
 
 python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS $SERVER_FLAGS &
 SERVER=$!
+STANDBY=
+if [ -n "$STANDBY_PORT" ] && [ -n "$CENTER_CKPT" ]; then
+  # the standby binds its own port window now, promotes only when the
+  # primary's checkpoints appear AND the fleet re-dials it
+  python easgd_server.py $common --port $STANDBY_PORT --concurrent --standby \
+    --watchPrimary 127.0.0.1:$PORT --syncTimeout 15 \
+    --numSyncs $SYNCS $SERVER_FLAGS &
+  STANDBY=$!
+fi
+# KILL_AFTER_CKPTS=n SIGTERMs the primary once n checkpoints are on disk
+# (i.e. provably mid-serving with restorable state): the failover drill
+# from docs/HA.md — final flush, standby promotes, clients re-dial it
+if [ -n "$KILL_AFTER_CKPTS" ] && [ -n "$CENTER_CKPT" ]; then
+  (
+    while [ "$(ls "$CENTER_CKPT" 2>/dev/null | wc -l)" -lt "$KILL_AFTER_CKPTS" ]; do
+      sleep 0.2
+    done
+    echo "[chaos] $KILL_AFTER_CKPTS checkpoints on disk; SIGTERM primary $SERVER"
+    kill -TERM $SERVER
+  ) &
+fi
 python easgd_tester.py $common --numTests $NUMTESTS &
 TESTER=$!
-python easgd_client.py $common --nodeIndex 1 --verbose &
+python easgd_client.py $common --nodeIndex 1 --verbose $CLIENT_FLAGS &
 C1=$!
-python easgd_client.py $common --nodeIndex 2 --verbose &
+python easgd_client.py $common --nodeIndex 2 --verbose $CLIENT_FLAGS &
 C2=$!
-wait $SERVER $TESTER $C1 $C2
+wait $SERVER $TESTER $C1 $C2 $STANDBY

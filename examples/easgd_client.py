@@ -26,6 +26,10 @@ def main():
                           "Rejoin? instead of crashing — local params "
                           "reset to the CURRENT center, training "
                           "continues.  --autoRejoin 0 = fail fast"),
+        "centers": ("", "comma-separated standby centers (host:port or "
+                        "just port) to fail over to when the primary "
+                        "dies for good (docs/HA.md); with --autoRejoin, "
+                        "a dead rejoin falls back to walking this list"),
     })
     setup_platform(1, opt.tpu)
     obs_http = obs_setup(opt)
@@ -49,10 +53,17 @@ def main():
     # sharded server — the Enter reply simply omits the stripe plan and
     # the sync runs on the dedicated conn alone); any other value lets
     # the server's advertised plan decide.
+    centers = []
+    for tok in opt.centers.split(","):
+        tok = tok.strip()
+        if tok:
+            h, _, pp = tok.rpartition(":")
+            centers.append((h or opt.host, int(pp)))
     client = AsyncEAClient(opt.host, opt.port, node=opt.nodeIndex,
                            tau=opt.communicationTime, alpha=opt.alpha,
                            codec=codec, overlap=opt.overlapSync,
-                           sharded=opt.shards != 0)
+                           sharded=opt.shards != 0,
+                           centers=centers or None)
     params = client.init_client(params)
 
     @jax.jit
@@ -88,7 +99,19 @@ def main():
                     raise
                 print_client(opt.nodeIndex,
                              f"sync failed ({e!r}); rejoining")
-                params = client.rejoin(params)
+                try:
+                    # with standbys configured, don't grind through the
+                    # full retry budget against a center that may be dead
+                    # for good — fail over while the promoted standby is
+                    # still holding its rejoin window open
+                    params = client.rejoin(params,
+                                           retries=6 if centers else 60)
+                except (OSError, ProtocolError):
+                    # the primary itself is gone: walk the dial list to
+                    # a (possibly freshly promoted) standby — LOCAL
+                    # params and residuals survive this path, only the
+                    # rejoin above resets to the center (docs/HA.md)
+                    params = client.failover(params)
                 step += 1
                 continue
             params = apply_sgd(params, grads)

@@ -31,6 +31,21 @@ def main():
                              "before stopping the serve loop (0 = wait "
                              "forever, the reference's behavior — set it "
                              "when clients may die without cleanup)"),
+        "centerCkpt": ("", "HA checkpoint directory (docs/HA.md): "
+                           "periodically checkpoint the center + failover "
+                           "ledger there and flush once more on SIGTERM; "
+                           "a --standby process tails the same directory"),
+        "ckptEvery": (8, "checkpoint the center every N applied syncs "
+                         "(with --centerCkpt)"),
+        "standby": (False, "start as a warm standby (requires "
+                           "--concurrent and --centerCkpt): bind "
+                           "listeners but admit nobody, wait for a "
+                           "checkpoint, promote into the next center "
+                           "epoch, then serve rejoining clients"),
+        "watchPrimary": ("", "standby only: probe this primary "
+                             "(host:port or just port) and promote when "
+                             "it stops answering, instead of promoting "
+                             "on the first checkpoint seen"),
     })
     setup_platform(1, opt.tpu)
     obs_http = obs_setup(opt)
@@ -55,13 +70,46 @@ def main():
     print_server(f"serving {opt.numNodes} clients, {num_syncs} syncs, "
                  f"tester={opt.tester}")
 
+    if opt.standby and not (opt.concurrent and opt.centerCkpt):
+        raise SystemExit("--standby requires --concurrent and --centerCkpt")
+    if opt.standby and opt.tester:
+        raise SystemExit("--standby is incompatible with --tester "
+                         "(no test channel is accepted pre-promotion)")
+
     if opt.concurrent:
         import time as _time
+        from distlearn_tpu.parallel import ha
         srv = AsyncEAServerConcurrent(opt.host, opt.port, opt.numNodes,
                                       with_tester=opt.tester,
-                                      shards=max(1, opt.shards))
-        srv.init_server(params)
+                                      shards=max(1, opt.shards),
+                                      standby=opt.standby)
+        if opt.standby:
+            sb = ha.StandbyCenter(srv, opt.centerCkpt, params)
+            if opt.watchPrimary:
+                h, _, pp = opt.watchPrimary.rpartition(":")
+                h = h or opt.host
+                print_server(f"standby: watching primary {h}:{pp}, "
+                             f"tailing {opt.centerCkpt}")
+                params = sb.watch(lambda: ha.tcp_probe(h, int(pp)))
+            else:
+                print_server("standby: waiting for a checkpoint in "
+                             f"{opt.centerCkpt}")
+                sb.wait_for_checkpoint()
+                params = sb.promote()
+        else:
+            srv.init_server(params)
+        if opt.centerCkpt:
+            srv.enable_checkpoint(opt.centerCkpt,
+                                  every=max(1, opt.ckptEvery))
+            ha.install_signal_flush(srv)
         srv.start()
+        if opt.standby:
+            # rejoining clients arrive through the dispatcher's grace
+            # poll; don't let the live_clients==0 stop fire before the
+            # fleet has had a chance to re-dial
+            deadline = _time.time() + (opt.syncTimeout or 60.0)
+            while srv.live_clients == 0 and _time.time() < deadline:
+                _time.sleep(0.05)
         tests_pushed = last_ckpt = last_done = 0
         last_progress = _time.time()
         while srv.syncs_completed < num_syncs and srv.live_clients > 0:
@@ -109,6 +157,10 @@ def main():
     srv = AsyncEAServer(opt.host, opt.port, opt.numNodes,
                         with_tester=opt.tester, shards=max(1, opt.shards))
     srv.init_server(params)
+    if opt.centerCkpt:
+        from distlearn_tpu.parallel import ha
+        srv.enable_checkpoint(opt.centerCkpt, every=max(1, opt.ckptEvery))
+        ha.install_signal_flush(srv)
     served = 0
     for i in range(1, num_syncs + 1):
         try:

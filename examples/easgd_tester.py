@@ -64,7 +64,16 @@ def main():
     codec = None if opt.wireCodec in ("legacy", "raw") else opt.wireCodec
     tester = AsyncEATester(opt.host, opt.port, opt.numNodes, codec=codec)
     for round_i in range(1, opt.numTests + 1):
-        params = tester.start_test(params)   # blocks for server push
+        try:
+            params = tester.start_test(params)   # blocks for server push
+        except OSError as e:
+            # the center died (HA failover: a promoted standby serves
+            # WORKERS, not the test channel — docs/HA.md); the rounds
+            # already logged are the deliverable, so exit clean rather
+            # than crash the demo pipeline
+            print_tester(f"center gone after {round_i - 1} rounds "
+                         f"({e!r}); exiting")
+            break
         train_err = error_rate(params, mstate, ds)
         test_err = error_rate(params, mstate, ds_test)
         rec = logger.add(round=round_i, train_error=train_err,

@@ -1,0 +1,83 @@
+"""Chaos harness (tools/chaos.py): the scripted kill -> promote ->
+rejoin sequence converges to BITWISE parity with an unkilled reference
+run (tier-1, deterministic), the mid-flight kill recovers through the
+rejoin replay rather than the checkpoint alone, and the multi-client
+churn soak (slow) stays live with zero leaked fds/threads."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import chaos  # noqa: E402
+
+pytestmark = pytest.mark.chaos
+
+
+def test_parity_boundary_kill_promote_rejoin():
+    """Kill the center between rounds, promote a standby on another port
+    window, client fails over (same object, no restart): ten+ more
+    rounds later the fleet is bitwise identical to the unkilled S=1
+    reference — run_parity raises on any divergence or leak."""
+    report = chaos.run_parity(rounds=16, kills=(5,), shards=4)
+    assert report["failures"] == []
+    assert report["promotions"] == 1
+    assert report["redials"] >= 1
+    assert sum(report["replays"].values()) == 1
+
+
+def test_parity_double_kill_ping_pongs_windows():
+    """Two kills re-promote across the same two port windows — proves
+    the promoted center's checkpoints supersede the dead primary's
+    (step adoption), or the second promotion would restore stale state."""
+    report = chaos.run_parity(rounds=14, kills=(4, 9), shards=4)
+    assert report["failures"] == []
+    assert report["promotions"] == 2
+
+
+def test_parity_mid_stripe_kill_replays_pending_delta():
+    """Kill while the round's delta is on the wire: the restored ledger
+    tells the rejoining client which stripes never landed and the replay
+    re-applies exactly those — bitwise parity still holds."""
+    report = chaos.run_parity(rounds=12, kills=(6,), shards=4,
+                              mid_flight=True)
+    assert report["failures"] == []
+    assert sum(report["replays"].values()) == 1
+
+
+def test_parity_without_overlap():
+    report = chaos.run_parity(rounds=10, kills=(4,), shards=2,
+                              overlap=False)
+    assert report["failures"] == []
+    assert report["promotions"] == 1
+
+
+def test_cli_parity_smoke():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos.py"),
+         "parity", "--rounds", "6", "--kills", "2", "--shards", "2"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-800:]
+    report = json.loads(r.stdout[r.stdout.index("{"):])
+    assert report["failures"] == [] and report["promotions"] == 1
+
+
+@pytest.mark.slow
+def test_churn_soak_liveness_and_leaks():
+    """The soak: three mixed-codec clients each self-kill mid-handshake,
+    the center dies twice under load — everyone finishes their rounds,
+    one promotion per center kill, no fd/thread accumulation."""
+    report = chaos.run_churn(rounds=14, num_clients=3, shards=4,
+                             server_kills=2)
+    assert report["failures"] == []
+    assert report["promotions"] == report["server_kills"] == 2
+    assert report["evictions"] >= 3 and report["rejoins"] >= 3

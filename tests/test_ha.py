@@ -1,0 +1,294 @@
+"""Center HA (docs/HA.md): checkpoint round-trips restore bitwise, a
+warm standby promotes into the next epoch, the epoch fence refuses
+zombies loudly, connect() backs off with full jitter, start/stop cycles
+leak nothing, SIGTERM flushes a final checkpoint, and diststat derives
+the failover table from the obs trail."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.comm import ProtocolError
+from distlearn_tpu.comm import transport as transport_mod
+from distlearn_tpu.obs import core
+from distlearn_tpu.parallel import ha
+from distlearn_tpu.parallel.async_ea import (AsyncEAServerConcurrent,
+                                             StaleCenterError, _leaves)
+from distlearn_tpu.utils.checkpoint import latest_step
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import chaos  # noqa: E402
+import diststat  # noqa: E402
+
+HOST = "127.0.0.1"
+
+
+@pytest.fixture()
+def obs_on():
+    core.configure(True)
+    core.REGISTRY.reset()
+    yield
+    core.REGISTRY.reset()
+    core.configure(None)
+
+
+def _bitwise(a: list[np.ndarray], b: list[np.ndarray]) -> bool:
+    return (len(a) == len(b)
+            and all(x.dtype == y.dtype and np.array_equal(x, y)
+                    for x, y in zip(a, b)))
+
+
+# ----------------------------------------------- checkpoint round-trips
+
+@pytest.mark.shard
+@pytest.mark.parametrize("codec", ["int8", "fp16"])
+@pytest.mark.parametrize("shards", [1, 4])
+def test_checkpoint_roundtrip_bitwise(tmp_path, obs_on, codec, shards):
+    """Sync under a quantized codec, checkpoint, restore into a FRESH
+    standby: every center leaf comes back bitwise identical, and the
+    promoted epoch fences out the old one."""
+    base = chaos._params()
+    port = chaos._reserve_window(8)
+    srv, (cl,), (p,) = chaos._spawn_fleet(HOST, port, 1, shards,
+                                          [codec], False, None, base)
+    standby = None
+    try:
+        srv.enable_checkpoint(str(tmp_path), every=1)
+        for r in range(3):
+            p = chaos._drift(p, r)
+            p, _ = cl.sync_client(p)
+        chaos._settle_fleet([cl], srv)
+        srv.checkpoint_now(wait=True)
+        want = chaos._leaves_of(srv)
+
+        restored, meta = ha.restore_center(str(tmp_path), base)
+        assert _bitwise(want, _leaves(restored))
+        assert meta["epoch"] == srv.epoch == 0
+        assert meta["shards"] == shards
+
+        win2 = chaos._reserve_window(8)
+        standby = AsyncEAServerConcurrent(HOST, win2, num_nodes=1,
+                                          shards=shards, standby=True)
+        ha.promote(standby, str(tmp_path), base)
+        assert standby.epoch == srv.epoch + 1
+        assert _bitwise(want, chaos._leaves_of(standby))
+        # the restored ledger covers every stripe of the only client
+        assert len(standby._applied_seq[1]) == len(standby.stripes)
+    finally:
+        if standby is not None:
+            standby.close()
+        chaos._teardown([cl], srv)
+
+
+@pytest.mark.shard
+def test_mixed_fleet_readmits_after_promotion(tmp_path, obs_on):
+    """Legacy-free mixed fleet (raw + int8 + fp16 packed clients) against
+    a striped center: kill + promote, every client fails over and keeps
+    syncing — no client restart, one promotion, zero stale refusals."""
+    base = chaos._params()
+    wins = [chaos._reserve_window(10), chaos._reserve_window(10)]
+    srv, clients, ps = chaos._spawn_fleet(
+        HOST, wins[0], 3, 4, ["raw", "int8", "fp16"], False,
+        [(HOST, wins[1])], base)
+    try:
+        srv.enable_checkpoint(str(tmp_path), every=1)
+        for r in range(2):
+            for i, cl in enumerate(clients):
+                ps[i] = chaos._drift(ps[i], r)
+                ps[i], _ = cl.sync_client(ps[i])
+        chaos._settle_fleet(clients, srv)
+        srv = chaos._kill_and_promote(srv, HOST, wins[1], base,
+                                      str(tmp_path), 4, 1,
+                                      flush_first=True)
+        for i, cl in enumerate(clients):
+            ps[i] = chaos._drift(ps[i], 2)
+            ps[i] = chaos._sync_with_failover(cl, ps[i])
+        chaos._settle_fleet(clients, srv)
+        totals = chaos._totals(core.REGISTRY.snapshot())
+        assert totals["async_ea_failover_promotions_total"] == 1
+        assert totals.get("async_ea_failover_stale_refusals_total", 0) == 0
+        assert srv.syncs_completed >= 3
+    finally:
+        chaos._teardown(clients, srv)
+
+
+# ------------------------------------------------------- the epoch fence
+
+def test_stale_center_refused_and_dropped_from_dial_list(obs_on):
+    """A center older than what the client has synced with must refuse
+    the handshake loudly (StaleCenterError is-a ProtocolError), and the
+    failover walk must evict it from the dial list rather than retry."""
+    base = chaos._params()
+    port = chaos._reserve_window(4)
+    srv, (cl,), (p,) = chaos._spawn_fleet(HOST, port, 1, 1, ["raw"],
+                                          False, None, base)
+    try:
+        p, _ = cl.sync_client(chaos._drift(p, 0))
+        assert cl._seen_epoch == 0
+        cl._seen_epoch = 5   # as if a promoted center was seen elsewhere
+        with pytest.raises(StaleCenterError):
+            cl.sync_client(chaos._drift(p, 1))
+        assert issubclass(StaleCenterError, ProtocolError)
+        deadline = time.monotonic() + 5.0   # eviction lands dispatcher-side
+        while 1 not in srv.evicted and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert 1 in srv.evicted   # the zombie also dropped the client
+        # only stale centers remain on the dial list -> loud failure,
+        # not an infinite re-dial loop
+        with pytest.raises(ConnectionError):
+            cl.failover(p, retries=3, retry_interval=0.01,
+                        handshake_timeout=2.0)
+        assert cl._centers == []
+        totals = chaos._totals(core.REGISTRY.snapshot())
+        # both sides count refusals into the family and the test runs
+        # them in one process: server Enter fence + server rejoin fence
+        # + the client's dial-walk eviction
+        assert totals["async_ea_failover_stale_refusals_total"] == 3
+    finally:
+        chaos._teardown([cl], srv)
+
+
+# -------------------------------------------------- connect() backoff
+
+def test_connect_backoff_exponential_with_cap(obs_on, monkeypatch):
+    sleeps: list[float] = []
+    monkeypatch.setattr(transport_mod.time, "sleep",
+                        lambda s: sleeps.append(s))
+    monkeypatch.setattr(transport_mod.random, "uniform", lambda a, b: b)
+    port = chaos._reserve_window(1)   # reserved then released: refuses
+    with pytest.raises(ConnectionError):
+        transport_mod.connect(HOST, port, retries=5, retry_interval=0.1,
+                              max_interval=0.8)
+    # full-jitter cap doubles from retry_interval, clamped at max_interval
+    assert sleeps[:4] == [pytest.approx(0.1), pytest.approx(0.2),
+                          pytest.approx(0.4), pytest.approx(0.8)]
+    assert all(s <= 0.8 + 1e-9 for s in sleeps)
+    labeled = chaos._labeled(core.REGISTRY.snapshot(),
+                             "transport_connect_retries_total")
+    assert labeled.get('{"reason": "refused"}', 0) == 5
+
+
+def test_connect_jitter_samples_below_cap(monkeypatch):
+    draws: list[tuple[float, float]] = []
+    monkeypatch.setattr(transport_mod.time, "sleep", lambda s: None)
+    monkeypatch.setattr(transport_mod.random, "uniform",
+                        lambda a, b: draws.append((a, b)) or a)
+    port = chaos._reserve_window(1)
+    with pytest.raises(ConnectionError):
+        transport_mod.connect(HOST, port, retries=3, retry_interval=0.25,
+                              max_interval=5.0)
+    assert [d[0] for d in draws] == [0.0, 0.0, 0.0]
+    assert [d[1] for d in draws] == [pytest.approx(0.25),
+                                     pytest.approx(0.5),
+                                     pytest.approx(1.0)]
+
+
+# ------------------------------------------- shutdown hygiene (no leaks)
+
+def test_start_stop_cycles_leak_nothing(obs_on):
+    """Repeated fleet up/sync/down cycles (the chaos soak's inner loop)
+    must not accumulate fds or threads; the thread gauge returns to 0."""
+    base = chaos._params()
+    readings = []
+    for cycle in range(4):
+        port = chaos._reserve_window(6)
+        srv, (cl,), (p,) = chaos._spawn_fleet(HOST, port, 1, 2, ["raw"],
+                                              False, None, base)
+        p, _ = cl.sync_client(chaos._drift(p, cycle))
+        chaos._settle_fleet([cl], srv)
+        chaos._teardown([cl], srv)
+        deadline = time.monotonic() + 5.0
+        while (threading.active_count() > 1
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        readings.append((chaos._fd_count(), threading.active_count()))
+    fds = [f for f, _ in readings]
+    ths = [t for _, t in readings]
+    assert max(fds[1:]) <= fds[0], readings
+    assert max(ths[1:]) <= ths[0], readings
+    totals = chaos._totals(core.REGISTRY.snapshot())
+    assert totals.get("async_ea_server_threads", 0) == 0
+    assert totals.get("async_ea_inflight", 0) == 0
+
+
+# --------------------------------------------------- SIGTERM final flush
+
+def test_install_signal_flush_checkpoints_and_chains(tmp_path, obs_on):
+    base = chaos._params()
+    port = chaos._reserve_window(4)
+    srv, (cl,), (p,) = chaos._spawn_fleet(HOST, port, 1, 1, ["raw"],
+                                          False, None, base)
+    hits: list[int] = []
+    prev = signal.signal(signal.SIGUSR1, lambda n, f: hits.append(n))
+    try:
+        srv.enable_checkpoint(str(tmp_path), every=10 ** 9)
+        p, _ = cl.sync_client(chaos._drift(p, 0))
+        chaos._settle_fleet([cl], srv)
+        assert latest_step(str(tmp_path)) is None   # cadence never hit
+        ha.install_signal_flush(srv, signums=(signal.SIGUSR1,))
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        while not hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hits == [signal.SIGUSR1]   # prior handler chained
+        assert latest_step(str(tmp_path)) is not None
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+        chaos._teardown([cl], srv)
+
+
+# --------------------------------------------- diststat failover table
+
+def _counter(name, value, labels=None, labelnames=()):
+    return {"name": name, "kind": "counter", "help": "",
+            "labelnames": list(labelnames),
+            "samples": [{"labels": labels or {}, "value": value}]}
+
+
+def test_diststat_failover_table(tmp_path):
+    recs = [
+        {"type": "span", "name": "async_ea.promote", "ts": 1.0,
+         "dur": 0.2},
+        {"type": "span", "name": "async_ea.failover", "ts": 1.1,
+         "dur": 0.5},
+        {"type": "span", "name": "async_ea.failover", "ts": 1.2,
+         "dur": 0.1},
+        {"type": "snapshot", "ts": 2.0, "metrics": [
+            _counter("async_ea_evictions_total", 3),
+            _counter("async_ea_rejoins_total", 3),
+            _counter("async_ea_failover_redials_total", 4),
+            _counter("async_ea_failover_promotions_total", 1),
+            _counter("center_ckpt_saves_total", 7),
+            {"name": "async_ea_failover_replays_total", "kind": "counter",
+             "help": "", "labelnames": ["outcome"],
+             "samples": [{"labels": {"outcome": "replayed"}, "value": 2},
+                         {"labels": {"outcome": "clean"}, "value": 1}]},
+        ]},
+    ]
+    log = tmp_path / "run.jsonl"
+    log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    tab = diststat.summarize_run([str(log)])["failover"]
+    assert tab["evictions"] == 3 and tab["rejoins"] == 3
+    assert tab["redials"] == 4 and tab["promotions"] == 1
+    assert tab["ckpt_saves"] == 7
+    assert tab["replays"] == {"clean": 1, "replayed": 2}
+    assert tab["latency"]["async_ea.promote"]["count"] == 1
+    assert tab["latency"]["async_ea.failover"]["p50"] == pytest.approx(0.1)
+
+
+def test_diststat_failover_table_empty_without_activity(tmp_path):
+    log = tmp_path / "run.jsonl"
+    log.write_text(json.dumps(
+        {"type": "snapshot", "ts": 1.0, "metrics": [
+            _counter("async_ea_syncs_total", 5)]}) + "\n")
+    assert diststat.summarize_run([str(log)])["failover"] == {}

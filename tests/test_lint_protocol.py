@@ -158,3 +158,33 @@ def test_lock_audit_quiet_on_repo_threaded_modules():
     from distlearn_tpu.comm import ring, transport, tree
     from distlearn_tpu.parallel import async_ea
     assert lock_order_audit([transport, tree, ring, async_ea]) == []
+
+
+# --------------------------------------------------- HA failover schedules
+
+def test_failover_promote_schedule_is_clean():
+    from distlearn_tpu.lint.protocol import async_ea_failover_schedule
+    assert check_schedules(async_ea_failover_schedule()) == []
+    assert check_schedules(async_ea_failover_schedule(num_shards=1)) == []
+
+
+def test_failover_without_timeouts_deadlocks():
+    """Why every stripe-leg recv is timeout-armed: if the surviving legs
+    waited forever on the killed primary, the whole fleet would wedge
+    instead of failing over (DL101 on the strict variant)."""
+    from distlearn_tpu.lint.protocol import async_ea_failover_schedule
+    fs = check_schedules(async_ea_failover_schedule(strict=True),
+                         name="failover-strict")
+    assert _rules(fs) == ["DL101"]
+
+
+def test_promote_rejoin_herd_schedule_is_clean():
+    from distlearn_tpu.lint.protocol import async_ea_promote_rejoin_schedule
+    assert check_schedules(async_ea_promote_rejoin_schedule()) == []
+    assert check_schedules(
+        async_ea_promote_rejoin_schedule(num_clients=5)) == []
+
+
+def test_stale_epoch_refusal_schedule_is_clean():
+    from distlearn_tpu.lint.protocol import async_ea_stale_epoch_schedule
+    assert check_schedules(async_ea_stale_epoch_schedule()) == []

@@ -1,0 +1,565 @@
+#!/usr/bin/env python
+"""chaos — kill/promote soak driver for the AsyncEA center HA stack.
+
+Two scenarios (docs/HA.md):
+
+    python tools/chaos.py parity --rounds 16 --kills 5,11 [--mid-flight]
+    python tools/chaos.py churn  --rounds 12 --clients 3 --server-kills 2
+
+``parity`` runs one client against a striped concurrent center with
+checkpointing on, kills the center at the requested rounds (either on a
+round boundary or genuinely mid-stripe-leg with ``--mid-flight``),
+promotes a standby on a second port window each time, and asserts the
+surviving fleet converges to BITWISE the same center and client params
+as an unkilled S=1 reference run — plus zero leaked fds/threads and
+clean obs counters (``async_ea_failover_*``, ``center_ckpt_*``).  The
+client object is never restarted; recovery is ``AsyncEAClient.failover``
+walking its dial list.
+
+Why bitwise parity holds under any kill point: the client's flush-at-
+top-of-sync raises BEFORE any param mutation, so its local trajectory
+is kill-invariant; and the per-(cid, stripe) applied-seq ledger is
+checkpointed in the same lock hold as the center slice it covers, so
+the rejoin replay re-applies exactly the stripes the restored center is
+missing — never zero, never twice (docs/HA.md).
+
+``churn`` is the multi-client liveness soak (the ``slow``/``chaos``
+marked tier-2 test): random-ish client self-kills mid-handshake plus
+center kills under load; asserts every client finishes its rounds, one
+promotion per center kill, and no fd/thread accumulation — not parity
+(rejoin adopts the current center, deliberately forking the
+trajectory).
+
+Importable: tests/test_chaos.py drives run_parity/run_churn directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+import time
+from contextlib import closing
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from distlearn_tpu.comm import ProtocolError  # noqa: E402
+from distlearn_tpu.obs import core  # noqa: E402
+from distlearn_tpu.parallel import ha  # noqa: E402
+from distlearn_tpu.parallel.async_ea import (  # noqa: E402
+    ENTER, ENTER_Q, AsyncEAClient, AsyncEAServerConcurrent)
+
+_SYNC_ERRORS = (OSError, TimeoutError, ProtocolError)
+
+
+def _reserve_window(n: int, host: str = "127.0.0.1") -> int:
+    """A base port whose window ``base .. base+n-1`` was just bindable
+    (tests/net_util.py idiom — tools must not import tests/)."""
+    for _ in range(256):
+        with closing(socket.socket()) as probe:
+            probe.bind((host, 0))
+            base = probe.getsockname()[1]
+        if base + n >= 65535:
+            continue
+        socks = []
+        try:
+            try:
+                for i in range(n):
+                    s = socket.socket()
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind((host, base + i))
+                    socks.append(s)
+            except OSError:
+                continue
+            return base
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"could not reserve a {n}-port window")
+
+
+def _params() -> dict:
+    """Six float32 leaves, ragged shapes (mirrors the shard tests) —
+    exercises sub-leaf striping at S=4."""
+    rng = np.random.default_rng(0)
+    return {k: rng.standard_normal(shape).astype(np.float32)
+            for k, shape in (("a", (64, 3)), ("b", (7,)), ("c", (32, 32)),
+                             ("d", (5,)), ("e", (128,)), ("f", (2, 2)))}
+
+
+def _drift(p: dict, r: int) -> dict:
+    """Deterministic dyadic local 'training' step — exactly
+    representable in float32, so parity can be asserted bitwise."""
+    step = np.float32((r % 5) + 0.25)
+    return {k: v + step for k, v in p.items()}
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def _totals(snap: list[dict]) -> dict:
+    """Counter/gauge family name -> summed value across label sets."""
+    out = {}
+    for fam in snap:
+        if fam["kind"] not in ("counter", "gauge"):
+            continue
+        out[fam["name"]] = sum(s.get("value", 0) for s in fam["samples"])
+    return out
+
+
+def _labeled(snap: list[dict], name: str) -> dict:
+    for fam in snap:
+        if fam["name"] == name:
+            return {json.dumps(s["labels"], sort_keys=True): s["value"]
+                    for s in fam["samples"]}
+    return {}
+
+
+def _quiet(srv) -> bool:
+    with srv._lock:
+        if srv._inflight:
+            return False
+    return (all(q.empty() for q in srv._queues)
+            and all(q.empty() for q in srv._shard_queues.values()))
+
+
+def _settle_fleet(clients, srv, timeout: float = 30.0) -> None:
+    """Block until every submitted delta is fully applied: overlap
+    senders flushed, no leg in flight, sync count stable across two
+    quiet polls."""
+    for cl in clients:
+        if cl._sender is not None:
+            cl._sender.flush()
+    deadline = time.monotonic() + timeout
+    last = -1
+    while time.monotonic() < deadline:
+        if _quiet(srv):
+            n = srv.syncs_completed
+            if n == last:
+                return
+            last = n
+        else:
+            last = -1
+        time.sleep(0.05)
+    raise RuntimeError("fleet did not settle (legs still in flight)")
+
+
+def _spawn_fleet(host, port, num_clients, shards, codecs, overlap,
+                 centers, params, handshake_timeout=5.0,
+                 rejoin_grace=60.0):
+    """Server + clients, concurrently (both constructors block on the
+    accept/dial handshake).  Returns (server, [clients], [params])."""
+    box: dict = {}
+
+    def _dial(i):
+        try:
+            box[i] = AsyncEAClient(
+                host, port, node=i + 1, tau=1, alpha=0.5,
+                codec=codecs[i % len(codecs)], overlap=overlap,
+                centers=centers)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            box[i] = e
+
+    threads = [threading.Thread(target=_dial, args=(i,), daemon=True)
+               for i in range(num_clients)]
+    for t in threads:
+        t.start()
+    srv = AsyncEAServerConcurrent(
+        host, port, num_nodes=num_clients, shards=shards,
+        accept_timeout=60.0, handshake_timeout=handshake_timeout,
+        rejoin_grace=rejoin_grace)
+    for t in threads:
+        t.join(timeout=60.0)
+    clients = []
+    for i in range(num_clients):
+        got = box.get(i)
+        if not isinstance(got, AsyncEAClient):
+            raise RuntimeError(f"client {i + 1} dial failed: {got!r}")
+        clients.append(got)
+    srv.init_server(params)
+    ps = [cl.init_client(params) for cl in clients]
+    srv.start()
+    return srv, clients, ps
+
+
+def _kill_and_promote(srv, host, new_port, params, ckpt_dir, shards,
+                      ckpt_every, *, flush_first, stop_deadline=2.0,
+                      handshake_timeout=5.0, rejoin_grace=60.0):
+    """The failover event: (optionally checkpoint, then) kill the
+    primary, construct a standby on the other port window, promote it
+    from the checkpoint directory, start serving.  Returns the promoted
+    server."""
+    if flush_first:
+        srv.checkpoint_now(wait=True)
+    srv.stop(deadline=stop_deadline)
+    srv.close()   # blocks on the async ckpt writer: promotion sees it
+    standby = AsyncEAServerConcurrent(
+        host, new_port, num_nodes=srv.num_nodes, shards=shards,
+        handshake_timeout=handshake_timeout, rejoin_grace=rejoin_grace,
+        standby=True)
+    ha.promote(standby, ckpt_dir, params)
+    standby.enable_checkpoint(ckpt_dir, every=ckpt_every)
+    standby.start()
+    return standby
+
+
+def _sync_with_failover(cl, p, attempts: int = 100):
+    """One round's sync, retried through ``failover`` until it lands.
+    The drift for the round happened OUTSIDE this loop, so a retry
+    replays the same local state."""
+    last = None
+    for _ in range(attempts):
+        try:
+            p2, _ = cl.sync_client(p)
+            return p2
+        except _SYNC_ERRORS as e:
+            last = e
+            cl.failover(p, retries=40, retry_interval=0.01,
+                        handshake_timeout=15.0)
+    raise RuntimeError(f"sync never succeeded after failover: {last!r}")
+
+
+def _leaves_of(srv) -> list[np.ndarray]:
+    return [np.asarray(t) for t in srv._snapshot()]
+
+
+def _teardown(clients, srv):
+    for cl in clients:
+        try:
+            cl.close()
+        except (OSError, RuntimeError):
+            pass
+    srv.stop(deadline=5.0)
+    srv.close()
+
+
+def _settle_leaks(fd_base: int, th_base: int, timeout: float = 10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _fd_count() <= fd_base and threading.active_count() <= th_base:
+            break
+        time.sleep(0.1)
+    return _fd_count(), threading.active_count()
+
+
+def _run_reference(host: str, rounds: int, overlap: bool) -> tuple:
+    """Unkilled S=1 raw-wire run — the parity oracle."""
+    port = _reserve_window(4, host)
+    base = _params()
+    srv, (cl,), (p,) = _spawn_fleet(host, port, 1, 1, ["raw"], overlap,
+                                    None, base)
+    for r in range(rounds):
+        p = _drift(p, r)
+        p, _ = cl.sync_client(p)
+    _settle_fleet([cl], srv)
+    center = _leaves_of(srv)
+    _teardown([cl], srv)
+    return p, center
+
+
+def run_parity(rounds: int = 16, kills=(6,), shards: int = 4,
+               overlap: bool = True, ckpt_every: int = 1,
+               mid_flight: bool = False, host: str = "127.0.0.1") -> dict:
+    """Kill/promote soak asserting bitwise convergence-to-parity.
+
+    ``kills``: rounds at which the center dies.  Boundary mode kills
+    between rounds (checkpoint flushed first); ``mid_flight`` kills
+    while the kill-round's delta is on the wire, so recovery leans on
+    the rejoin replay instead of the checkpoint alone.
+    """
+    kills = sorted(set(int(k) for k in kills))
+    if kills and (kills[0] < 1 or kills[-1] >= rounds):
+        raise ValueError("kill rounds must fall inside 1..rounds-1")
+    core.configure(True)
+    core.REGISTRY.reset()
+    tmp = tempfile.mkdtemp(prefix="chaos-ckpt-")
+    try:
+        ref_p, ref_center = _run_reference(host, rounds, overlap)
+        fd_base, th_base = _fd_count(), threading.active_count()
+
+        windows = [_reserve_window(8, host), _reserve_window(8, host)]
+        win = 0
+        base = _params()
+        srv, (cl,), (p,) = _spawn_fleet(
+            host, windows[0], 1, shards, ["raw"], overlap,
+            [(host, windows[1])], base)
+        srv.enable_checkpoint(tmp, every=ckpt_every)
+        killset = set(kills)
+        for r in range(rounds):
+            if r in killset:
+                _settle_fleet([cl], srv)
+                if mid_flight:
+                    # prior rounds durable; the kill-round delta itself
+                    # is covered by the ledger + rejoin replay
+                    srv.checkpoint_now(wait=True)
+                    p = _drift(p, r)
+                    p, _ = cl.sync_client(p)
+                    win = 1 - win
+                    srv = _kill_and_promote(
+                        srv, host, windows[win], base, tmp, shards,
+                        ckpt_every, flush_first=False, stop_deadline=0.25)
+                    continue
+                win = 1 - win
+                srv = _kill_and_promote(
+                    srv, host, windows[win], base, tmp, shards,
+                    ckpt_every, flush_first=True)
+            p = _drift(p, r)
+            p = _sync_with_failover(cl, p)
+        _settle_fleet([cl], srv)
+        center = _leaves_of(srv)
+        _teardown([cl], srv)
+        fd_end, th_end = _settle_leaks(fd_base, th_base)
+        snap = core.REGISTRY.snapshot()
+
+        totals = _totals(snap)
+        failures = []
+        for i, (a, b) in enumerate(zip(ref_center, center)):
+            if a.dtype != b.dtype or not np.array_equal(a, b):
+                failures.append(f"center leaf {i} diverged "
+                                f"(max |d|={np.abs(a - b).max()})")
+        for k in ref_p:
+            if not np.array_equal(ref_p[k], p[k]):
+                failures.append(f"client param {k!r} diverged")
+        n_kills = len(kills)
+        checks = [
+            ("promotions", totals.get(
+                "async_ea_failover_promotions_total", 0), n_kills),
+            ("ckpt_restores", totals.get(
+                "center_ckpt_restores_total", 0), n_kills),
+            ("stale_refusals", totals.get(
+                "async_ea_failover_stale_refusals_total", 0), 0),
+        ]
+        for name, got, want in checks:
+            if got != want:
+                failures.append(f"{name}: got {got}, want {want}")
+        if totals.get("async_ea_failover_redials_total", 0) < n_kills:
+            failures.append("fewer re-dials than kills")
+        if totals.get("center_ckpt_saves_total", 0) < 1:
+            failures.append("no checkpoints were saved")
+        if totals.get("async_ea_server_threads", 0) != 0:
+            failures.append("server thread gauge nonzero after stop")
+        if totals.get("async_ea_inflight", 0) != 0:
+            failures.append("inflight gauge nonzero after stop")
+        if fd_end > fd_base + 2:
+            failures.append(f"fd leak: {fd_base} -> {fd_end}")
+        if th_end > th_base:
+            failures.append(f"thread leak: {th_base} -> {th_end}")
+
+        report = {
+            "scenario": "parity",
+            "rounds": rounds, "kills": kills, "shards": shards,
+            "overlap": overlap, "mid_flight": mid_flight,
+            "promotions": totals.get(
+                "async_ea_failover_promotions_total", 0),
+            "redials": totals.get("async_ea_failover_redials_total", 0),
+            "replays": _labeled(snap,
+                                "async_ea_failover_replays_total"),
+            "ckpt_saves": totals.get("center_ckpt_saves_total", 0),
+            "fds": [fd_base, fd_end], "threads": [th_base, th_end],
+            "failures": failures,
+        }
+        if failures:
+            raise AssertionError("chaos parity failed: "
+                                 + "; ".join(failures)
+                                 + f"\n{json.dumps(report, indent=2)}")
+        return report
+    finally:
+        core.REGISTRY.reset()
+        core.configure(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _client_self_kill(cl):
+    """Die mid-handshake: announce Enter?, then vanish.  The center's
+    handshake deadline evicts the cid; the same client object later
+    recovers through rejoin/failover — no restart."""
+    try:
+        cl._announce(ENTER_Q, ENTER)
+    except Exception:  # noqa: BLE001 — dying is the point
+        pass
+    for c in (cl.broadcast, cl.conn, *cl._shard_conns):
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
+def _recover(cl, p, deadline_s: float = 120.0):
+    """Post-self-kill recovery loop: rejoin the current center (must
+    wait out our own eviction), falling back to the failover dial walk
+    when the center itself died meanwhile."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            return cl.rejoin(p, retries=5, retry_interval=0.02,
+                             handshake_timeout=5.0)
+        except _SYNC_ERRORS:
+            time.sleep(0.05)
+        try:
+            return cl.failover(p, retries=10, retry_interval=0.02,
+                               handshake_timeout=5.0)
+        except _SYNC_ERRORS:
+            time.sleep(0.05)
+    raise RuntimeError(f"client {cl.node} could not recover")
+
+
+def run_churn(rounds: int = 12, num_clients: int = 3, shards: int = 4,
+              overlap: bool = True, server_kills: int = 2,
+              ckpt_every: int = 1, host: str = "127.0.0.1") -> dict:
+    """Multi-client liveness soak: every client self-kills once
+    (mid-handshake), the center dies ``server_kills`` times under load.
+    Asserts liveness + counter sanity + zero leaks, NOT parity."""
+    core.configure(True)
+    core.REGISTRY.reset()
+    tmp = tempfile.mkdtemp(prefix="chaos-churn-")
+    fd_base, th_base = _fd_count(), threading.active_count()
+    try:
+        nports = num_clients + 2 + max(0, shards - 1)
+        windows = [_reserve_window(nports, host),
+                   _reserve_window(nports, host)]
+        base = _params()
+        codecs = ["raw", "int8", "fp16"]   # mixed fleet
+        srv, clients, ps = _spawn_fleet(
+            host, windows[0], num_clients, shards, codecs, overlap,
+            [(host, windows[1])], base,
+            handshake_timeout=2.0, rejoin_grace=120.0)
+        srv.enable_checkpoint(tmp, every=ckpt_every)
+
+        errors: dict = {}
+        done = threading.Event()
+
+        def _drive(i, cl, p):
+            kill_round = 2 + (i % max(1, rounds - 3))
+            try:
+                for r in range(rounds):
+                    if r == kill_round:
+                        _client_self_kill(cl)
+                        p = _recover(cl, p)
+                    p = _drift(p, r)
+                    p = _sync_with_failover(cl, p)
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors[i] = e
+
+        threads = [threading.Thread(target=_drive, args=(i, cl, p),
+                                    daemon=True)
+                   for i, (cl, p) in enumerate(zip(clients, ps))]
+        for t in threads:
+            t.start()
+
+        # center kills from the main thread, spread across the run
+        win, kills_done = 0, 0
+        total = rounds * num_clients
+        srv_box = [srv]
+        while any(t.is_alive() for t in threads):
+            if (kills_done < server_kills
+                    and srv_box[0].syncs_completed
+                    >= (kills_done + 1) * total // (server_kills + 1)):
+                win = 1 - win
+                srv_box[0] = _kill_and_promote(
+                    srv_box[0], host, windows[win], base, tmp, shards,
+                    ckpt_every, flush_first=True, stop_deadline=2.0,
+                    handshake_timeout=2.0, rejoin_grace=120.0)
+                kills_done += 1
+            time.sleep(0.05)
+        done.set()
+        for t in threads:
+            t.join(timeout=60.0)
+
+        _teardown(clients, srv_box[0])
+        fd_end, th_end = _settle_leaks(fd_base, th_base)
+        snap = core.REGISTRY.snapshot()
+
+        totals = _totals(snap)
+        failures = [f"client {i + 1} died: {e!r}"
+                    for i, e in sorted(errors.items())]
+        if any(t.is_alive() for t in threads):
+            failures.append("client threads still alive (liveness)")
+        if totals.get("async_ea_failover_promotions_total",
+                      0) != kills_done:
+            failures.append("promotions != server kills")
+        if totals.get("async_ea_evictions_total", 0) < num_clients:
+            failures.append("fewer evictions than client self-kills")
+        if totals.get("async_ea_rejoins_total", 0) < num_clients:
+            failures.append("fewer rejoins than client self-kills")
+        if totals.get("async_ea_server_threads", 0) != 0:
+            failures.append("server thread gauge nonzero after stop")
+        if totals.get("async_ea_inflight", 0) != 0:
+            failures.append("inflight gauge nonzero after stop")
+        if fd_end > fd_base + 2:
+            failures.append(f"fd leak: {fd_base} -> {fd_end}")
+        if th_end > th_base:
+            failures.append(f"thread leak: {th_base} -> {th_end}")
+
+        report = {
+            "scenario": "churn",
+            "rounds": rounds, "clients": num_clients, "shards": shards,
+            "server_kills": kills_done,
+            "promotions": totals.get(
+                "async_ea_failover_promotions_total", 0),
+            "evictions": totals.get("async_ea_evictions_total", 0),
+            "rejoins": totals.get("async_ea_rejoins_total", 0),
+            "redials": totals.get("async_ea_failover_redials_total", 0),
+            "replays": _labeled(snap,
+                                "async_ea_failover_replays_total"),
+            "fds": [fd_base, fd_end], "threads": [th_base, th_end],
+            "failures": failures,
+        }
+        if failures:
+            raise AssertionError("chaos churn failed: "
+                                 + "; ".join(failures)
+                                 + f"\n{json.dumps(report, indent=2)}")
+        return report
+    finally:
+        core.REGISTRY.reset()
+        core.configure(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    pp = sub.add_parser("parity", help="kill/promote bitwise-parity soak")
+    pp.add_argument("--rounds", type=int, default=16)
+    pp.add_argument("--kills", default="6",
+                    help="comma-separated kill rounds (1..rounds-1)")
+    pp.add_argument("--shards", type=int, default=4)
+    pp.add_argument("--no-overlap", action="store_true")
+    pp.add_argument("--mid-flight", action="store_true",
+                    help="kill while the round's delta is on the wire")
+    pp.add_argument("--ckpt-every", type=int, default=1)
+    cp = sub.add_parser("churn", help="multi-client liveness soak")
+    cp.add_argument("--rounds", type=int, default=12)
+    cp.add_argument("--clients", type=int, default=3)
+    cp.add_argument("--shards", type=int, default=4)
+    cp.add_argument("--server-kills", type=int, default=2)
+    cp.add_argument("--no-overlap", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cmd == "parity":
+        kills = [int(k) for k in str(args.kills).split(",") if k.strip()]
+        report = run_parity(rounds=args.rounds, kills=kills,
+                            shards=args.shards,
+                            overlap=not args.no_overlap,
+                            ckpt_every=args.ckpt_every,
+                            mid_flight=args.mid_flight)
+    else:
+        report = run_churn(rounds=args.rounds, num_clients=args.clients,
+                           shards=args.shards,
+                           overlap=not args.no_overlap,
+                           server_kills=args.server_kills)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

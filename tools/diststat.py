@@ -165,6 +165,49 @@ def shard_table(counters: dict, histograms: dict) -> dict:
     return dict(sorted(tab.items(), key=lambda kv: (len(kv[0]), kv[0])))
 
 
+_FAILOVER_COUNTERS = {
+    "async_ea_evictions_total": "evictions",
+    "async_ea_rejoins_total": "rejoins",
+    "async_ea_failover_redials_total": "redials",
+    "async_ea_failover_promotions_total": "promotions",
+    "async_ea_failover_stale_refusals_total": "stale_refusals",
+    "center_ckpt_saves_total": "ckpt_saves",
+    "center_ckpt_restores_total": "ckpt_restores",
+}
+_REPLAYS_FAM = "async_ea_failover_replays_total"
+_FAILOVER_SPANS = ("async_ea.promote", "async_ea.failover")
+
+
+def failover_table(counter_totals: dict, counters: dict,
+                   spans: dict) -> dict:
+    """Derive the HA/failover table (docs/HA.md): eviction/rejoin/re-dial
+    counts, promotions and checkpoint traffic, replay outcomes, and the
+    promotion + client-failover latency quantiles from their spans.
+    Empty when the run had no failover activity at all."""
+    tab: dict = {}
+    for fam, col in _FAILOVER_COUNTERS.items():
+        v = counter_totals.get(fam, 0)
+        if v:
+            tab[col] = v
+    replays = {}
+    prefix = _REPLAYS_FAM + '{outcome="'
+    for key, v in counters.items():
+        if key.startswith(prefix) and key.endswith('"}'):
+            replays[key[len(prefix):-2]] = v
+    if replays:
+        tab["replays"] = dict(sorted(replays.items()))
+    lat = {}
+    for name in _FAILOVER_SPANS:
+        durs = spans.get(name)
+        if durs:
+            lat[name] = {"count": len(durs),
+                         "p50": _percentile(durs, 50),
+                         "p99": _percentile(durs, 99)}
+    if lat:
+        tab["latency"] = lat
+    return tab
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -187,7 +230,9 @@ def summarize_run(paths: list[str]) -> dict:
             "gauges": dict(sorted(run["gauges"].items())),
             "histograms": hist_tab,
             "wire": wire_table(run["counters"]),
-            "shards": shard_table(run["counters"], run["histograms"])}
+            "shards": shard_table(run["counters"], run["histograms"]),
+            "failover": failover_table(run["counter_totals"],
+                                       run["counters"], run["spans"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -278,6 +323,19 @@ def _print_summary(doc: dict):
             print(f"{shard:<8} {row['legs']:>8g} "
                   f"{row['wire_bytes']:>14g} {row['applies']:>9g} "
                   f"{_fmt_s(row['apply_mean']):>12}")
+        print()
+    if doc.get("failover"):
+        fo = doc["failover"]
+        print("failover:")
+        for col in ("evictions", "rejoins", "redials", "promotions",
+                    "stale_refusals", "ckpt_saves", "ckpt_restores"):
+            if col in fo:
+                print(f"  {col} = {fo[col]:g}")
+        for outcome, v in fo.get("replays", {}).items():
+            print(f"  replays[{outcome}] = {v:g}")
+        for name, row in fo.get("latency", {}).items():
+            print(f"  {name}: count={row['count']} "
+                  f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
 
 
 def _print_diff(doc: dict):
