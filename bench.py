@@ -1730,6 +1730,97 @@ def bench_pp_lm(batch, seq, iters, windows, peak):
     }
 
 
+def serve_bench(concurrencies=(1, 2, 4, 8), prompt_len: int = 16,
+                max_new: int = 32, dim: int = 256, depth: int = 4,
+                heads: int = 8, vocab: int = 512):
+    """Continuous-batched serving throughput vs the repo's sequential
+    decode path (docs/SERVING.md).
+
+    For each concurrency ``c``: ``c`` requests arrive at once, the
+    ``serve.engine`` admits them all and ticks until done — aggregate
+    tok/s plus TTFT (arrival to first token: queue-position cost made
+    visible, requests prefill one at a time) and TPOT (per-token
+    latency = tick wall time, one sample per request per tick) p50/p99.
+    The baseline is ``c`` back-to-back ``greedy_generate`` calls — the
+    pre-serve inference path (``examples/lm.py --generate``), which
+    dispatches eagerly per request; the engine's jitted tick amortizes
+    weight reads over every active slot, so the gap widens with ``c``.
+    """
+    import jax
+    import numpy as np
+    from distlearn_tpu.models.transformer import (greedy_generate,
+                                                  transformer_lm)
+    from distlearn_tpu.serve.engine import DecodeEngine
+    max_len = 1
+    while max_len < prompt_len + max_new:
+        max_len *= 2
+    model = transformer_lm(vocab=vocab, dim=dim, depth=depth, heads=heads,
+                           max_len=max_len)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+
+    def prompts(c, batched):
+        shape = (prompt_len,) if batched else (1, prompt_len)
+        return [rng.integers(1, vocab, size=shape).astype(np.int32)
+                for _ in range(c)]
+
+    # warm both paths out of the timed region (compile once per shape)
+    np.asarray(greedy_generate(params, prompts(1, False)[0], max_new))
+    eng = DecodeEngine(params, num_slots=max(concurrencies),
+                       max_len=max_len, page=16)
+    s, _ = eng.admit(prompts(1, True)[0], max_new)
+    eng.tick()
+    eng.finish(s)
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[max(0, min(len(xs) - 1,
+                             int(round(q / 100.0 * (len(xs) - 1)))))]
+
+    rows = []
+    for c in concurrencies:
+        ps = prompts(c, False)
+        t0 = time.perf_counter()
+        for p in ps:
+            np.asarray(greedy_generate(params, p, max_new))
+        seq_tok_s = c * max_new / (time.perf_counter() - t0)
+
+        ps = prompts(c, True)
+        ttft, tpot = [], []
+        t0 = time.perf_counter()
+        emitted = {}
+        for p in ps:
+            slot, _ = eng.admit(p, max_new)
+            ttft.append(time.perf_counter() - t0)
+            emitted[slot] = 1
+        done = 0
+        while done < c:
+            tt = time.perf_counter()
+            ticked = eng.tick()
+            dt = time.perf_counter() - tt
+            for slot in ticked:
+                tpot.append(dt)
+                emitted[slot] += 1
+                if emitted[slot] >= max_new:
+                    eng.finish(slot)
+                    done += 1
+        tok_s = c * max_new / (time.perf_counter() - t0)
+        row = {"concurrency": c, "tokens_per_sec": tok_s,
+               "sequential_tokens_per_sec": seq_tok_s,
+               "speedup_vs_sequential": tok_s / seq_tok_s,
+               "ttft_p50": pct(ttft, 50), "ttft_p99": pct(ttft, 99),
+               "tpot_p50": pct(tpot, 50), "tpot_p99": pct(tpot, 99)}
+        rows.append(row)
+        print(f"[bench] serve c={c}: {tok_s:.1f} tok/s "
+              f"(sequential {seq_tok_s:.1f}, "
+              f"{tok_s / seq_tok_s:.2f}x), TTFT p50={row['ttft_p50'] * 1e3:.1f}ms "
+              f"p99={row['ttft_p99'] * 1e3:.1f}ms, "
+              f"TPOT p50={row['tpot_p50'] * 1e3:.1f}ms", file=sys.stderr)
+    return {"model": {"dim": dim, "depth": depth, "heads": heads,
+                      "vocab": vocab, "max_len": max_len},
+            "prompt_len": prompt_len, "max_new": max_new, "rows": rows}
+
+
 def chip_health_probe():
     """Chained bf16 4096^3 matmuls ended by a REAL device_get (the
     platform's completion signaling is optimistic — r1 lesson).  Healthy
@@ -2283,6 +2374,12 @@ def main():
         if rows:
             details["transformer_lm_long"] = rows
 
+    # --- serving: continuous batching vs sequential decode ------------------
+    if os.environ.get("BENCH_SKIP_SERVE") != "1":
+        sv = run_bench_section("serve_bench", serve_bench)
+        if sv:
+            details["serve_bench"] = sv
+
     # --- modeled baseline ---------------------------------------------------
     baseline = (sps if platform == "cpu"
                 else cpu_baseline(batch))
@@ -2353,6 +2450,24 @@ if __name__ == "__main__":
         _enable_compile_cache()
         print(json.dumps(allreduce_bench(
             int(os.environ.get("BENCH_AR_MB", "64")))))
+    elif "--serve-probe" in sys.argv:
+        # Standalone serving probe: runs serve_bench alone and MERGES the
+        # result into BENCH_DETAILS.json (read-modify-write) so a serving
+        # re-measure doesn't discard the training rows from a full run.
+        _pin_cpu(1)
+        _enable_compile_cache()
+        sv = serve_bench()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_DETAILS.json")
+        try:
+            with open(path) as fh:
+                details = json.load(fh)
+        except (OSError, ValueError):
+            details = {}
+        details["serve_bench"] = sv
+        with open(path, "w") as fh:
+            json.dump(details, fh, indent=2)
+        print(json.dumps(sv["rows"]))
     elif "--multichip-probe" in sys.argv:
         _pin_cpu(int(os.environ.get("BENCH_MC_DEVICES", "8")))
         _enable_compile_cache()

@@ -11,6 +11,10 @@ Wire protocol (shared with the native C++ backend in src/comm/distcomm.cpp):
     kind 'P': payload is hlen:u32le | manifest[hlen] | packed leaf bytes —
               a whole tensor LIST in one frame (manifest schema and the
               raw/fp16/int8 leaf codecs: distlearn_tpu.comm.wire)
+    kind 'G': payload is UTF-8 JSON — a GENERATE request (prompt in):
+              {"id", "prompt": [ints], "max_new", ...} (docs/SERVING.md)
+    kind 'R': payload is UTF-8 JSON — one token-stream RESPONSE chunk
+              (tokens out): {"id", "tokens": [ints], "done", ...}
 
 Connection management (listen/accept/connect/poll) stays in Python; the
 byte-moving hot path (frame assembly, big-buffer send/recv loops) dispatches
@@ -132,6 +136,7 @@ class Conn:
             labels=("kind",))
         self._h_ctrl = lat.labels(kind="control")
         self._h_tensor = lat.labels(kind="tensor")
+        self._h_serve = lat.labels(kind="serve")
 
     def _pace(self, nbytes: int, t0: float):
         if self.throttle_bps:
@@ -291,6 +296,36 @@ class Conn:
         if self._obs:
             self._h_ctrl.observe(time.perf_counter() - t0)
         return json.loads(payload)
+
+    # -- serving frames (kinds 'G'/'R', distlearn_tpu.serve) ----------------
+    def send_gen(self, msg: Any):
+        """Send one generate REQUEST (kind ``'G'``): prompt in.  Payload
+        is JSON like a ``'J'`` frame; the distinct kind lets a serving
+        endpoint reject control traffic (and vice versa) without parsing
+        — a training client dialing a serve port desyncs loudly."""
+        self._send_frame(ord("G"), json.dumps(msg).encode())
+
+    def send_stream(self, msg: Any):
+        """Send one token-stream RESPONSE chunk (kind ``'R'``): tokens
+        out.  One frame per tick keeps time-to-first-token at one
+        decode tick, not one full generation."""
+        self._send_frame(ord("R"), json.dumps(msg).encode())
+
+    def recv_serve(self, deadline: float | None = None) -> tuple[str, Any]:
+        """Receive one serving-protocol frame: returns ``(kind, msg)``
+        with ``kind`` in ``'G'``/``'R'``/``'J'`` (``'J'`` stays legal so
+        control pings — health probes, drain notices — share the
+        connection).  Tensor frames raise :class:`ProtocolError`."""
+        t0 = time.perf_counter() if self._obs else 0.0
+        kind, length = self._recv_frame_header(deadline)
+        payload = bytes(self._recv_exact(length, mid_frame=True,
+                                         deadline=deadline))
+        if kind not in (ord("G"), ord("R"), ord("J")):
+            raise ProtocolError(
+                f"expected serve frame (G/R/J), got kind {chr(kind)!r}")
+        if self._obs:
+            self._h_serve.observe(time.perf_counter() - t0)
+        return chr(kind), json.loads(payload)
 
     # -- tensors ------------------------------------------------------------
     def send_tensor(self, arr: np.ndarray):

@@ -273,6 +273,30 @@ def _seq_family():
     return _lint_units(units, mesh)
 
 
+def _decode_family():
+    """Serving decode programs (distlearn_tpu.serve): the tp-sharded
+    continuous-batching tick and the bucketed prefill.  The cost
+    lockfile pins the two psums per block — a serving regression that
+    adds collectives to the per-token path shows up here, not at p99."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.serve.engine import DecodeEngine
+    tp = 2
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("model",))
+    model = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=64)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    eng = DecodeEngine(params, num_slots=4, page=8, mesh=mesh,
+                       tp_axis="model", donate=False)
+    units = [
+        ("decode_tick", eng.tick_program, eng.tick_args()),
+        ("decode_prefill", eng.prefill_program,
+         eng.prefill_args(eng.buckets[0])),
+    ]
+    return _lint_units(units, mesh)
+
+
 def _protocol_family():
     from distlearn_tpu.lint.protocol import (async_ea_sync_schedule,
                                              check_schedules,
@@ -310,6 +334,9 @@ _FAMILIES = {
                 _ep_family),
     "seq": Entry("seq", "sequence-parallel attention (ring/zigzag/ulysses)",
                  _seq_family),
+    "decode": Entry("decode",
+                    "serving decode programs (continuous-batch tick + "
+                    "paged prefill)", _decode_family),
     "protocol": Entry("protocol",
                       "host comm schedules (tree/ring/AsyncEA) + lock audit",
                       _protocol_family),

@@ -396,9 +396,52 @@ def transformer_lm(vocab: int = 256, dim: int = 128, depth: int = 2,
                  input_shape=(max_len,), num_classes=vocab)
 
 
+def decode_attend(q: jax.Array, ck: jax.Array, cv: jax.Array,
+                  live: jax.Array, cd) -> jax.Array:
+    """One decode tick's cached attention: ``[B,1,H,D]`` query against the
+    ``[B,T,H,D]`` K/V cache under the boolean ``live`` mask (broadcastable
+    to ``[B,H,1,T]``; dead cache positions score ``-inf``).  The ONE home
+    of the cached-attention math, shared by :func:`greedy_generate` and
+    the slot-addressed serving engine (``distlearn_tpu.serve.engine``) —
+    token parity between the two is a tested invariant, so the math must
+    not fork."""
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                   preferred_element_type=jnp.float32)
+    s = s * (1.0 / (D ** 0.5))
+    s = jnp.where(live, s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(cd), cv)
+
+
+def generate_params(params: PyTree) -> tuple[PyTree, int]:
+    """Normalize a :func:`transformer_lm` tree for decoding: unstack the
+    scanned layout, reject MoE blocks (per-tick routing would compute
+    expert capacity over one token — a different model than the one
+    trained), and return ``(per_block_params, depth)``.  Shared by
+    :func:`greedy_generate` and the serving engine."""
+    # numpy trees (checkpoint loads, device_get'd sharded params) are
+    # legal input; the decode scan closes over the leaves, and a numpy
+    # leaf indexed by a tracer inside the scan body fails to trace.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    if "blocks" in params:
+        d = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
+        params = unstack_block_params(params, d)
+    depth = sum(1 for k in params if k.startswith("block"))
+    for i in range(depth):
+        if "router" in params[f"block{i}"]:
+            raise ValueError(
+                "greedy decoding supports dense blocks only: per-tick "
+                "MoE routing computes capacity over ONE token, not the "
+                "batch the router trained with (block"
+                f"{i} has a router)")
+    return params, depth
+
+
 def greedy_generate(params: PyTree, tokens: jax.Array, steps: int,
                     compute_dtype=None,
-                    attn_impl: str | None = None) -> jax.Array:
+                    attn_impl: str | None = None,
+                    prompt_lens: jax.Array | None = None) -> jax.Array:
     """KV-cached greedy decoding for a :func:`transformer_lm` parameter
     tree (per-block layout): ``[B, P]`` prompt -> ``[B, steps]``
     generated ids.
@@ -420,30 +463,40 @@ def greedy_generate(params: PyTree, tokens: jax.Array, steps: int,
     match the model's kernel (float-level kernel differences can flip
     argmax at near-tie logits).  Greedy (argmax) sampling.
 
+    ``prompt_lens`` (``[B]`` ints) lifts the equal-length restriction:
+    row ``b`` holds ``prompt_lens[b]`` real tokens LEFT-padded to ``P``
+    (pad ids are arbitrary — they are masked out of the attention and
+    get position 0's embedding).  Left padding keeps the decode loop
+    uniform: every row's last prompt token sits at column ``P-1``, so
+    the first generated position is column ``P`` for all rows and each
+    row's logical positions are ``column - (P - prompt_lens[b])``.
+    ``prompt_lens=None`` is the original equal-length path, bit-for-bit
+    unchanged (tested).
+
     Equivalence to the no-cache rollout is tested
     (tests/test_transformer.py).
     """
-    if "blocks" in params:
-        d = jax.tree_util.tree_leaves(params["blocks"])[0].shape[0]
-        params = unstack_block_params(params, d)
-    depth = sum(1 for k in params if k.startswith("block"))
-    for i in range(depth):
-        if "router" in params[f"block{i}"]:
-            raise ValueError(
-                "greedy_generate supports dense blocks only: per-tick "
-                "MoE routing computes capacity over ONE token, not the "
-                "batch the router trained with (block"
-                f"{i} has a router)")
+    params, depth = generate_params(params)
     cd = compute_dtype or params["embed"].dtype
     B, P = tokens.shape
     T = P + steps
     if T > params["pos"].shape[0]:
         raise ValueError(f"prompt + steps = {T} exceeds max_len "
                          f"{params['pos'].shape[0]}")
+    if prompt_lens is not None:
+        plens = jnp.asarray(prompt_lens, jnp.int32).reshape(B)
+        pad = (P - plens)[:, None]                 # [B,1] left-pad widths
 
     # ---- prefill: full causal pass, caches seeded with the prompt K/V
-    x = params["embed"][tokens].astype(cd)
-    x = x + params["pos"][:P].astype(cd)[None]
+    if prompt_lens is None:
+        x = params["embed"][tokens].astype(cd)
+        x = x + params["pos"][:P].astype(cd)[None]
+    else:
+        # logical position of column j in row b: j - pad_b (pads clamp to
+        # 0 — they never contribute: masked out of every attention below)
+        pos_idx = jnp.maximum(jnp.arange(P)[None, :] - pad, 0)   # [B,P]
+        x = params["embed"][tokens].astype(cd)
+        x = x + params["pos"][pos_idx].astype(cd)
     caches = []
     for i in range(depth):
         blk = params[f"block{i}"]
@@ -452,7 +505,28 @@ def greedy_generate(params: PyTree, tokens: jax.Array, steps: int,
         cv = jnp.zeros((B, T) + v.shape[2:], v.dtype)
         caches.append((lax.dynamic_update_slice_in_dim(ck, k, 0, 1),
                        lax.dynamic_update_slice_in_dim(cv, v, 0, 1)))
-        att = local_attention(q, k, v, causal=True, impl=attn_impl)
+        if prompt_lens is None:
+            att = local_attention(q, k, v, causal=True, impl=attn_impl)
+        else:
+            # causal AND key-not-pad: same einsum shape as the decode
+            # tick, applied over all P query positions at once
+            D = q.shape[-1]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s * (1.0 / (D ** 0.5))
+            cols = jnp.arange(P)
+            # [B,1,q,k]: key k visible to query q iff k <= q (causal) and
+            # k is past row b's left padding.  Pad queries additionally
+            # see themselves: an all-masked softmax is NaN, and 0*NaN
+            # poisons the value einsum for the REAL queries too — self
+            # attention keeps pad lanes finite (their K/V stay masked
+            # out of every real lane, here and in the decode ticks).
+            mask = ((cols[None, None, None, :] <= cols[None, None, :, None])
+                    & (cols[None, :] >= pad)[:, None, None, :]) \
+                | jnp.eye(P, dtype=bool)[None, None]
+            s = jnp.where(mask, s, -jnp.inf)
+            w = jax.nn.softmax(s, axis=-1)
+            att = jnp.einsum("bhqk,bkhd->bqhd", w.astype(cd), v)
         x = attn_out(blk, x, att, cd)
         x = ffn_apply(blk, x, cd)
     x = _rmsnorm(params["out_norm"], x)
@@ -462,8 +536,12 @@ def greedy_generate(params: PyTree, tokens: jax.Array, steps: int,
     def decode(carry, _):
         tok, pos, caches = carry                   # tok [B], pos scalar
         x = params["embed"][tok].astype(cd)[:, None]
-        x = x + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
-                                         0).astype(cd)[None]
+        if prompt_lens is None:
+            x = x + lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                             0).astype(cd)[None]
+        else:
+            # row b decodes logical position plens_b + (pos - P)
+            x = x + params["pos"][plens + (pos - P)].astype(cd)[:, None]
         new_caches = []
         for i in range(depth):
             blk = params[f"block{i}"]
@@ -472,15 +550,11 @@ def greedy_generate(params: PyTree, tokens: jax.Array, steps: int,
             ck = lax.dynamic_update_slice_in_dim(ck, k1, pos, 1)
             cv = lax.dynamic_update_slice_in_dim(cv, v1, pos, 1)
             new_caches.append((ck, cv))
-            D = q.shape[-1]
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
-                           preferred_element_type=jnp.float32)
-            s = s * (1.0 / (D ** 0.5))
             live = jnp.arange(T)[None, None, None, :] <= pos
-            s = jnp.where(live, s, -jnp.inf)
-            w = jax.nn.softmax(s, axis=-1)
-            att = jnp.einsum("bhqk,bkhd->bqhd", w.astype(cd), cv)
-            x = attn_out(blk, x, att, cd)
+            if prompt_lens is not None:
+                live = live & (jnp.arange(T)[None, :]
+                               >= pad)[:, None, None, :]
+            x = attn_out(blk, x, decode_attend(q, ck, cv, live, cd), cd)
             x = ffn_apply(blk, x, cd)
         x = _rmsnorm(params["out_norm"], x)
         lg = (x[:, 0] @ params["embed"].T.astype(cd)).astype(jnp.float32)

@@ -10,8 +10,10 @@ the JSONL trail into p50/p95/p99 tables and run diffs.
 Instrumented layers: ``comm/transport.py`` (per-conn wire bytes, frame
 latency, timeout/drop/desync counters), ``parallel/async_ea.py``
 (syncs, handshake spans, evictions/rejoins, inflight, center-apply
-time), ``train/trainer.py`` (step dispatch timing) and
-``data/prefetch.py`` (queue depth).
+time), ``train/trainer.py`` (step dispatch timing),
+``data/prefetch.py`` (queue depth), and the decode service
+``serve/`` (TTFT/TPOT histograms, queue/slot gauges, request
+outcomes, tick/prefill spans — docs/SERVING.md).
 
 Kill switch: ``DISTLEARN_OBS=0`` makes every factory return a no-op
 sink; the catalog of metric and span names lives in
@@ -23,7 +25,8 @@ from distlearn_tpu.obs.core import (NULL, REGISTRY, configure, counter,
                                     snapshot_record)
 from distlearn_tpu.obs.export import (set_health_source, start_http_server,
                                       write_snapshot)
-from distlearn_tpu.obs.trace import set_spill, span, spans, traced
+from distlearn_tpu.obs.trace import (record_span, set_spill, span, spans,
+                                     traced)
 
 __all__ = [
     "NULL",
@@ -37,6 +40,7 @@ __all__ = [
     "set_health_source",
     "start_http_server",
     "write_snapshot",
+    "record_span",
     "set_spill",
     "span",
     "spans",

@@ -145,6 +145,23 @@ def span(name: str, **labels):
     return _Span(name, labels)
 
 
+def record_span(name: str, dur: float, **labels):
+    """Record a span whose duration was measured by the caller.
+
+    For intervals that don't map to one ``with`` block — e.g. a serving
+    request's time-to-first-token spans submit → first stream frame
+    across scheduler and engine code that never holds both endpoints.
+    The record shape matches :class:`_Span` so trail consumers
+    (``tools/diststat.py``) need no special case."""
+    if not core.enabled():
+        return
+    rec = {"type": "span", "name": name, "ts": time.time(),
+           "dur": float(dur)}
+    if labels:
+        rec["labels"] = labels
+    _record(rec)
+
+
 def traced(name: str | None = None):
     """Decorator form: ``@traced()`` uses the function's qualname."""
 

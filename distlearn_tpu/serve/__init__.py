@@ -1,0 +1,46 @@
+"""distserve — continuous-batched, sharded, observable decode service.
+
+The inference half of the north star: the trained transformer behind a
+socket.  Orca-style continuous batching (requests join and leave the
+running batch between decode ticks) over a vLLM-style fixed-slot paged
+KV cache, with the decode tick compiled ONCE as a jit/shard_map program
+over tp-sharded weights.
+
+Layers, bottom up:
+
+* ``serve.kv_cache`` — host-side slot/page bookkeeping
+  (:class:`PagedKVCache`): block tables, lengths, admit/release, the
+  no-stale-reads + trash-page + exact-accounting invariants.
+* ``serve.engine`` — :class:`DecodeEngine`: bucketed prefill and the
+  batched slot-addressed decode tick, token-identical to
+  ``models.transformer.greedy_generate``.
+* ``serve.scheduler`` — :class:`Scheduler`: bounded admission queue,
+  FIFO admit, deadline eviction; emits events, owns no sockets.
+* ``serve.server`` / ``serve.client`` — :class:`ServeServer` wires the
+  scheduler to ``comm.transport`` ('G'/'R' frames), ``obs`` (gauges,
+  TTFT/TPOT histograms + spans, ``/healthz``) and SIGTERM drain via
+  ``ha.install_signal_flush``; :class:`ServeClient` is the matching
+  one-request driver.
+
+Demo: ``examples/lm.py --serve`` + ``examples/lm_client.py``; protocol
+and runbook in docs/SERVING.md.
+"""
+
+from distlearn_tpu.serve.client import ServeClient, ServeError
+from distlearn_tpu.serve.engine import DecodeEngine
+from distlearn_tpu.serve.kv_cache import CacheFull, PagedKVCache
+from distlearn_tpu.serve.scheduler import Event, QueueFull, Request, Scheduler
+from distlearn_tpu.serve.server import ServeServer
+
+__all__ = [
+    "CacheFull",
+    "DecodeEngine",
+    "Event",
+    "PagedKVCache",
+    "QueueFull",
+    "Request",
+    "Scheduler",
+    "ServeClient",
+    "ServeError",
+    "ServeServer",
+]

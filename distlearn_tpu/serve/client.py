@@ -1,0 +1,77 @@
+"""Minimal request driver for the serving protocol (client side of
+``serve.server``): dial, send one ``'G'`` frame, iterate ``'R'`` chunks
+until ``done``.  Used by ``examples/lm_client.py`` and the e2e tests;
+deliberately synchronous — concurrency is the SERVER's job (continuous
+batching), a load generator just opens more connections.
+"""
+
+from __future__ import annotations
+
+import time
+
+from distlearn_tpu.comm import transport
+
+
+class ServeError(RuntimeError):
+    """Server rejected or aborted the request (``error`` field, or a
+    terminal reason other than ``complete``/``eos``)."""
+
+
+class ServeClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 retries: int = 60):
+        self.conn = transport.connect(host, port, retries=retries)
+
+    def ping(self, timeout: float = 5.0) -> dict:
+        """Control round-trip ('J' frame): returns the server's health
+        snapshot (queue depth, active slots, draining flag)."""
+        self.conn.send_msg({"q": "stats"})
+        return self.conn.recv_msg(deadline=time.monotonic() + timeout)
+
+    def generate(self, prompt, max_new: int, *, rid: str | None = None,
+                 deadline_s: float | None = None, eos: int | None = None,
+                 timeout: float = 60.0, on_chunk=None) -> dict:
+        """Run one request to completion.  Returns
+        ``{"rid", "tokens", "reason"}``; raises :class:`ServeError` on a
+        server-side rejection/abort and :class:`TimeoutError` when no
+        chunk lands within ``timeout``.  ``on_chunk(tokens)`` streams
+        partial output as it arrives."""
+        msg = {"prompt": [int(t) for t in prompt], "max_new": int(max_new)}
+        if rid is not None:
+            msg["rid"] = rid
+        if deadline_s is not None:
+            msg["deadline_s"] = float(deadline_s)
+        if eos is not None:
+            msg["eos"] = int(eos)
+        self.conn.send_gen(msg)
+        tokens: list[int] = []
+        while True:
+            kind, chunk = self.conn.recv_serve(
+                deadline=time.monotonic() + timeout)
+            if kind != "R":
+                raise transport.ProtocolError(
+                    f"expected stream chunk, got kind {kind!r}")
+            if rid is not None and chunk.get("rid") not in (rid, ""):
+                continue      # chunk for another request on a shared conn
+            if chunk.get("error"):
+                raise ServeError(chunk["error"])
+            got = chunk.get("tokens") or []
+            tokens.extend(int(t) for t in got)
+            if got and on_chunk is not None:
+                on_chunk(got)
+            if chunk.get("done"):
+                reason = chunk.get("reason", "complete")
+                if reason not in ("complete", "eos"):
+                    raise ServeError(f"request ended: {reason}")
+                return {"rid": chunk.get("rid"), "tokens": tokens,
+                        "reason": reason}
+
+    def close(self):
+        self.conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,324 @@
+"""Slot-addressed decode engine — ``greedy_generate``'s prefill/decode
+internals refactored for continuous batching (Orca, Yu et al. OSDI '22).
+
+:func:`distlearn_tpu.models.transformer.greedy_generate` fuses prefill +
+decode into one program over one batch that lives and dies together.  A
+SERVICE can't do that: requests arrive and finish at different times, so
+the engine splits the two phases into separately compiled programs over
+a persistent paged K/V pool (:mod:`distlearn_tpu.serve.kv_cache`):
+
+* :meth:`DecodeEngine.admit` runs the PREFILL program for one request —
+  a full causal pass over its (bucket-padded) prompt whose K/V scatter
+  lands in the slot's pages — and returns the first generated token.
+* :meth:`DecodeEngine.tick` runs the DECODE program: every active slot
+  advances one token in a single dispatch, each slot gathering its own
+  K/V through its block-table row.  A request admitted between ticks
+  prefills into slot k while the other slots' cached state just sits in
+  the pool — nothing is recomputed or rolled back.
+
+Both programs are built from the SAME block math as training and
+``greedy_generate`` (``attn_qkv`` / ``attn_out`` / ``ffn_apply`` /
+``decode_attend``), so continuous-batched decoding is token-identical
+to N isolated ``greedy_generate`` calls — a tier-1-tested invariant
+(tests/test_serve.py).
+
+Tensor parallelism: pass ``mesh``/``tp_axis`` and both programs wrap
+their body in ``shard_map`` inside ``jax.jit`` (the mesh-wrapped compile
+pattern): weights shard per ``param_specs``, the K/V pools shard over
+the heads axis, and ``attn_out``/``ffn_apply`` insert the two psums per
+block exactly as the training step does.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from distlearn_tpu import obs
+from distlearn_tpu.models.transformer import (_rmsnorm, attn_out, attn_qkv,
+                                              decode_attend, ffn_apply,
+                                              generate_params, param_specs)
+from distlearn_tpu.serve.kv_cache import CacheFull, PagedKVCache
+
+PyTree = Any
+
+__all__ = ["DecodeEngine", "CacheFull"]
+
+
+def _buckets(max_len: int) -> tuple[int, ...]:
+    """Prompt-length compile buckets: powers of two up to ``max_len``
+    (inclusive as the last bucket) — prompts pad up to the next bucket
+    so the prefill program retraces O(log max_len) times, not once per
+    distinct prompt length."""
+    out = []
+    b = 8
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class DecodeEngine:
+    """Continuous-batching decode engine over a fixed-slot paged cache.
+
+    ``params`` is a dense :func:`transformer_lm` tree (per-block or
+    scanned layout; MoE rejected).  ``num_slots`` bounds concurrent
+    requests; ``max_len`` bounds ``prompt + generated`` per request and
+    sizes the page pool (every slot can hold a full-length request).
+    """
+
+    def __init__(self, params: PyTree, *, num_slots: int = 4,
+                 max_len: int | None = None, page: int = 16,
+                 compute_dtype=None, mesh=None, tp_axis: str | None = None,
+                 donate: bool = True):
+        import jax
+        import jax.numpy as jnp
+        self._jax, self._jnp = jax, jnp
+        params, self.depth = generate_params(params)
+        self.params = params
+        self.cd = compute_dtype or params["embed"].dtype
+        self.max_len = int(max_len or params["pos"].shape[0])
+        if self.max_len > params["pos"].shape[0]:
+            raise ValueError(f"max_len={self.max_len} exceeds the model's "
+                             f"positional table {params['pos'].shape[0]}")
+        wq = params["block0"]["wq"]
+        self.heads, self.head_dim = wq.shape[1], wq.shape[2]
+        if (mesh is None) != (tp_axis is None):
+            raise ValueError("mesh and tp_axis come together (both or "
+                             "neither)")
+        if tp_axis is not None and self.heads % mesh.shape[tp_axis]:
+            raise ValueError(
+                f"{self.heads} heads not divisible by the {tp_axis} axis "
+                f"({mesh.shape[tp_axis]})")
+        self.mesh, self.tp_axis = mesh, tp_axis
+        self.cache = PagedKVCache(num_slots, page, self.max_len)
+        self.buckets = _buckets(self.max_len)
+        shape = (self.depth, self.cache.num_pages, page,
+                 self.heads, self.head_dim)
+        self._k = jnp.zeros(shape, self.cd)
+        self._v = jnp.zeros(shape, self.cd)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            self._kv_spec = self._pspec(None, None, None, tp_axis)
+            sh = NamedSharding(mesh, self._kv_spec)
+            self._k = jax.device_put(self._k, sh)
+            self._v = jax.device_put(self._v, sh)
+        self._tick_fn = self._build_tick(donate)
+        self._prefill_fn = self._build_prefill(donate)
+        self._m_ticks = obs.counter("serve_engine_ticks_total",
+                                    "decode ticks dispatched")
+        self._m_prefills = obs.counter("serve_engine_prefills_total",
+                                       "prefill programs dispatched")
+        self._h_tick = obs.histogram("serve_tick_seconds",
+                                     "one decode tick: dispatch to tokens "
+                                     "on host")
+
+    # -- program construction ----------------------------------------------
+    def _pspec(self, *names):
+        from jax.sharding import PartitionSpec as P
+        return P(*names)
+
+    def _wrap(self, body, in_specs, out_specs, donate):
+        """jit(shard_map(body)) under TP, plain jit otherwise — the
+        mesh-wrapped compile pattern: the mesh is captured at build time
+        so callers never need a mesh context."""
+        jax = self._jax
+        if self.mesh is None:
+            return jax.jit(body, donate_argnums=(1, 2) if donate else ())
+        from distlearn_tpu.utils.compat import shard_map
+        mapped = shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        return jax.jit(mapped, donate_argnums=(1, 2) if donate else ())
+
+    def _build_tick(self, donate):
+        jnp = self._jnp
+        params, depth, cd, tp = self.params, self.depth, self.cd, self.tp_axis
+        page = self.cache.page
+        T = self.cache.pages_per_slot * page
+
+        def tick(p, kpool, vpool, bt, lens, toks, active):
+            S = toks.shape[0]
+            pos = lens                                    # position written
+            x = p["embed"][toks].astype(cd)[:, None]      # [S,1,E]
+            x = x + p["pos"][pos].astype(cd)[:, None]
+            # inactive slots write to the trash page (their block-table
+            # rows are all 0 already, but pos//page could index past the
+            # row for a stale pos — clamp through where)
+            row = jnp.clip(pos // page, 0, bt.shape[1] - 1)
+            pages = jnp.where(active, bt[jnp.arange(S), row], 0)
+            offs = jnp.where(active, pos % page, 0)
+            for i in range(depth):
+                blk = p[f"block{i}"]
+                q, k1, v1 = attn_qkv(blk, x, cd, tp)      # [S,1,H,D]
+                kpool = kpool.at[i, pages, offs].set(k1[:, 0])
+                vpool = vpool.at[i, pages, offs].set(v1[:, 0])
+                # paged gather: each slot's block-table row pulls its
+                # pages from the pool -> a contiguous [S,T,H,D] view
+                ck = kpool[i][bt].reshape(S, T, k1.shape[2], k1.shape[3])
+                cv = vpool[i][bt].reshape(S, T, v1.shape[2], v1.shape[3])
+                live = (jnp.arange(T)[None] <= pos[:, None])[:, None, None]
+                x = attn_out(blk, x, decode_attend(q, ck, cv, live, cd),
+                             cd, tp)
+                x = ffn_apply(blk, x, cd, tp_axis=tp)
+            x = _rmsnorm(p["out_norm"], x)
+            lg = (x[:, 0] @ p["embed"].T.astype(cd)).astype(jnp.float32)
+            return kpool, vpool, jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+        P_ = self._pspec
+        specs_in = (param_specs(params, self.tp_axis), self._kv_spec,
+                    self._kv_spec, P_(), P_(), P_(), P_()) \
+            if self.mesh is not None else None
+        specs_out = (self._kv_spec, self._kv_spec, P_()) \
+            if self.mesh is not None else None
+        return self._wrap(tick, specs_in, specs_out, donate)
+
+    def _build_prefill(self, donate):
+        jnp = self._jnp
+        lax = self._jax.lax
+        from distlearn_tpu.parallel.sequence import local_attention
+        params, depth, cd, tp = self.params, self.depth, self.cd, self.tp_axis
+        page = self.cache.page
+
+        def prefill(p, kpool, vpool, btrow, tokens, plen):
+            # tokens [1, Pb] RIGHT-padded to the bucket: causal attention
+            # means positions < plen never see the garbage tail, and the
+            # tail's K/V scatter is routed to the trash page below.
+            Pb = tokens.shape[1]
+            x = p["embed"][tokens].astype(cd)
+            x = x + p["pos"][:Pb].astype(cd)[None]
+            posn = jnp.arange(Pb)
+            valid = posn < plen
+            pages = jnp.where(valid, btrow[posn // page], 0)
+            offs = jnp.where(valid, posn % page, 0)
+            for i in range(depth):
+                blk = p[f"block{i}"]
+                q, k, v = attn_qkv(blk, x, cd, tp)
+                kpool = kpool.at[i, pages, offs].set(k[0])
+                vpool = vpool.at[i, pages, offs].set(v[0])
+                att = local_attention(q, k, v, causal=True)
+                x = attn_out(blk, x, att, cd, tp)
+                x = ffn_apply(blk, x, cd, tp_axis=tp)
+            x = _rmsnorm(p["out_norm"], x)
+            last = lax.dynamic_index_in_dim(x[0], plen - 1, 0,
+                                            keepdims=False)
+            lg = (last @ p["embed"].T.astype(cd)).astype(jnp.float32)
+            return kpool, vpool, jnp.argmax(lg).astype(jnp.int32)
+
+        P_ = self._pspec
+        specs_in = (param_specs(params, self.tp_axis), self._kv_spec,
+                    self._kv_spec, P_(), P_(), P_()) \
+            if self.mesh is not None else None
+        specs_out = (self._kv_spec, self._kv_spec, P_()) \
+            if self.mesh is not None else None
+        return self._wrap(prefill, specs_in, specs_out, donate)
+
+    # -- capacity -----------------------------------------------------------
+    def has_capacity(self, prompt_len: int, max_new: int) -> bool:
+        return self.cache.can_admit(int(prompt_len) + int(max_new))
+
+    def active_slots(self) -> list[int]:
+        return np.flatnonzero(self.cache.active).tolist()
+
+    def bucket_for(self, plen: int) -> int:
+        for b in self.buckets:
+            if plen <= b:
+                return b
+        raise ValueError(f"prompt length {plen} exceeds max_len "
+                         f"{self.max_len}")
+
+    # -- request lifecycle --------------------------------------------------
+    def admit(self, prompt: np.ndarray, max_new: int) -> tuple[int, int]:
+        """Prefill ``prompt`` (1-D int array) into a free slot; returns
+        ``(slot, first_token)``.  Raises :class:`CacheFull` when no
+        slot/pages fit (gate on :meth:`has_capacity`) and ``ValueError``
+        when ``prompt + max_new`` exceeds ``max_len``."""
+        jnp = self._jnp
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = len(prompt)
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new={max_new} must be >= 1")
+        total = plen + int(max_new)
+        if total > self.max_len:
+            raise ValueError(f"prompt({plen}) + max_new({max_new}) = "
+                             f"{total} exceeds max_len {self.max_len}")
+        slot = self.cache.admit(total)
+        bucket = self.bucket_for(plen)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :plen] = prompt
+        with obs.span("serve.prefill", slot=slot, bucket=bucket):
+            self._k, self._v, first = self._prefill_fn(
+                self.params, self._k, self._v,
+                jnp.asarray(self.cache.block_table[slot]),
+                jnp.asarray(padded), jnp.int32(plen))
+            first = int(first)
+        self._m_prefills.inc()
+        self.cache.lengths[slot] = plen
+        self.cache.last_tok[slot] = first
+        return slot, first
+
+    def tick(self) -> dict[int, int]:
+        """Advance every active slot one token in ONE dispatch; returns
+        ``{slot: next_token}``.  Slots whose cache allocation is spent
+        (``length == limit``) are skipped — the scheduler should have
+        finished them; skipping keeps a late finish from scattering past
+        the slot's pages."""
+        jnp = self._jnp
+        c = self.cache
+        runnable = c.active & (c.lengths < c.limit)
+        if not runnable.any():
+            return {}
+        t0 = time.perf_counter()
+        with obs.span("serve.tick", slots=int(runnable.sum())):
+            self._k, self._v, nxt = self._tick_fn(
+                self.params, self._k, self._v,
+                jnp.asarray(c.block_table), jnp.asarray(c.lengths),
+                jnp.asarray(c.last_tok), jnp.asarray(runnable))
+            nxt = np.asarray(nxt)
+        self._h_tick.observe(time.perf_counter() - t0)
+        self._m_ticks.inc()
+        out = {}
+        for slot in np.flatnonzero(runnable):
+            slot = int(slot)
+            c.lengths[slot] += 1            # last_tok's K/V is now cached
+            c.last_tok[slot] = int(nxt[slot])
+            out[slot] = int(nxt[slot])
+        return out
+
+    def finish(self, slot: int):
+        """Release the slot's pages (request done or evicted)."""
+        self.cache.release(slot)
+
+    # -- lint/bench hooks ---------------------------------------------------
+    def tick_args(self):
+        """Abstract args for the decode-tick program (distlint's cost
+        pass compiles the identical program the service runs)."""
+        jax, c = self._jax, self.cache
+        sd = jax.ShapeDtypeStruct
+        kv = sd(self._k.shape, self._k.dtype)
+        return (self.params, kv, kv,
+                sd(c.block_table.shape, "int32"),
+                sd(c.lengths.shape, "int32"),
+                sd(c.last_tok.shape, "int32"),
+                sd(c.active.shape, "bool"))
+
+    def prefill_args(self, bucket: int | None = None):
+        jax, c = self._jax, self.cache
+        sd = jax.ShapeDtypeStruct
+        kv = sd(self._k.shape, self._k.dtype)
+        b = bucket or self.buckets[0]
+        return (self.params, kv, kv,
+                sd((c.pages_per_slot,), "int32"),
+                sd((1, b), "int32"), sd((), "int32"))
+
+    @property
+    def tick_program(self):
+        return self._tick_fn
+
+    @property
+    def prefill_program(self):
+        return self._prefill_fn

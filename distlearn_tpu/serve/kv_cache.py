@@ -1,0 +1,150 @@
+"""Fixed-slot paged KV cache — the serving engine's memory manager.
+
+vLLM/PagedAttention (Kwon et al., SOSP '23) decouples a request's
+logical K/V sequence from physical storage: the device holds one page
+POOL per layer (``[depth, num_pages, page, H, D]``) and each of the
+``num_slots`` request slots owns a BLOCK TABLE row mapping its logical
+pages to pool pages.  Admission allocates exactly the pages a request
+can ever touch (``prompt_len + max_new`` positions, rounded up to whole
+pages); finish/evict returns them to the free list.  Slots are the unit
+of batching: the decode tick (``serve.engine``) advances every ACTIVE
+slot by one token in a single compiled program, gathering each slot's
+K/V through its block-table row.
+
+Admit/evict/finish happen BETWEEN ticks, on the host, in plain Python —
+this module never imports the compiled side.  It owns three invariants
+the tests pin down (tests/test_serve.py):
+
+* **No stale reads.**  Freed pages are returned to the pool without
+  zeroing.  A new request can only read cache positions below its own
+  current length, and every one of those positions was freshly written
+  by its OWN prefill scatter or decode ticks — so recycled bytes are
+  never observable (the parity test decodes through heavy slot reuse
+  and must stay token-identical to isolated runs).
+* **Page 0 is the trash page.**  It is never allocated; freed block
+  table rows reset to 0 and unallocated tail entries stay 0, so masked
+  lanes (inactive slots, padded prefill tails) scatter there instead of
+  into live data.
+* **Exact accounting.**  ``free_pages + pages-in-tables == num_pages-1``
+  at all times; double-free and double-admit raise instead of
+  corrupting the pool.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class CacheFull(RuntimeError):
+    """No free slot, or not enough free pages for the request."""
+
+
+class PagedKVCache:
+    """Host-side slot/page bookkeeping for the serving engine.
+
+    The device arrays (the pools themselves) live in the engine; this
+    class owns the integer state the compiled tick consumes: the block
+    tables, per-slot lengths, last-emitted tokens, and the active mask.
+    """
+
+    def __init__(self, num_slots: int, page: int, max_len: int,
+                 num_pages: int | None = None):
+        if num_slots < 1 or page < 1 or max_len < 1:
+            raise ValueError(f"num_slots={num_slots}, page={page}, "
+                             f"max_len={max_len} must all be >= 1")
+        self.num_slots = int(num_slots)
+        self.page = int(page)
+        self.max_len = int(max_len)
+        #: logical pages a slot can address (the gather width of the tick)
+        self.pages_per_slot = -(-self.max_len // self.page)
+        # default pool: every slot can hold a full-length request, plus
+        # the reserved trash page 0
+        if num_pages is None:
+            num_pages = self.num_slots * self.pages_per_slot + 1
+        if num_pages < 2:
+            raise ValueError(f"num_pages={num_pages} leaves no allocatable "
+                             "page beyond the reserved trash page 0")
+        self.num_pages = int(num_pages)
+        self._free: list[int] = list(range(self.num_pages - 1, 0, -1))
+        # block_table[s, j] = pool page backing slot s's logical page j
+        # (0 = trash: unallocated)
+        self.block_table = np.zeros((self.num_slots, self.pages_per_slot),
+                                    np.int32)
+        self.lengths = np.zeros((self.num_slots,), np.int32)
+        self.last_tok = np.zeros((self.num_slots,), np.int32)
+        self.active = np.zeros((self.num_slots,), bool)
+        #: per-slot hard cap (prompt_len + max_new) — the engine stops a
+        #: slot before it writes past its allocation
+        self.limit = np.zeros((self.num_slots,), np.int32)
+
+    # -- capacity queries ---------------------------------------------------
+    def pages_for(self, total_len: int) -> int:
+        return -(-int(total_len) // self.page)
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def free_slots(self) -> int:
+        return int((~self.active).sum())
+
+    def can_admit(self, total_len: int) -> bool:
+        """True when a request needing ``total_len`` cache positions has
+        both a free slot and enough free pages."""
+        return (self.free_slots() > 0
+                and self.pages_for(total_len) <= len(self._free)
+                and total_len <= self.max_len)
+
+    # -- slot lifecycle -----------------------------------------------------
+    def admit(self, total_len: int) -> int:
+        """Claim a free slot and allocate pages for ``total_len`` cache
+        positions.  Returns the slot index; raises :class:`CacheFull`
+        when capacity is short (callers gate on :meth:`can_admit`)."""
+        total_len = int(total_len)
+        if total_len < 1 or total_len > self.max_len:
+            raise ValueError(f"total_len={total_len} outside "
+                             f"[1, max_len={self.max_len}]")
+        need = self.pages_for(total_len)
+        if need > len(self._free):
+            raise CacheFull(f"{need} pages needed, {len(self._free)} free")
+        free = np.flatnonzero(~self.active)
+        if not len(free):
+            raise CacheFull("all slots busy")
+        slot = int(free[0])
+        for j in range(need):
+            self.block_table[slot, j] = self._free.pop()
+        self.lengths[slot] = 0
+        self.last_tok[slot] = 0
+        self.limit[slot] = total_len
+        self.active[slot] = True
+        return slot
+
+    def release(self, slot: int):
+        """Finish/evict: return the slot's pages to the pool and reset
+        its block-table row to trash.  Page contents are NOT zeroed —
+        the no-stale-reads invariant (module docstring) makes that
+        unnecessary, and skipping it keeps eviction O(pages) host work."""
+        slot = int(slot)
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active (double release?)")
+        for j in range(self.pages_per_slot):
+            p = int(self.block_table[slot, j])
+            if p:
+                self._free.append(p)
+            self.block_table[slot, j] = 0
+        self.lengths[slot] = 0
+        self.last_tok[slot] = 0
+        self.limit[slot] = 0
+        self.active[slot] = False
+
+    def check(self):
+        """Assert the exact-accounting invariant (test hook)."""
+        held = int((self.block_table > 0).sum())
+        if held + len(self._free) != self.num_pages - 1:
+            raise AssertionError(
+                f"page leak: {held} in tables + {len(self._free)} free "
+                f"!= {self.num_pages - 1} allocatable")
+        if len(set(self._free)) != len(self._free):
+            raise AssertionError("duplicate page in free list")
+        live = set(self.block_table[self.block_table > 0].tolist())
+        if live & set(self._free):
+            raise AssertionError("page both allocated and free")

@@ -1,0 +1,195 @@
+"""Continuous-batching scheduler — admission, ticking, eviction policy.
+
+Orca-style (Yu et al., OSDI '22) iteration-level scheduling: the unit
+of work is one engine tick, and the request mix is re-decided between
+ticks.  :meth:`Scheduler.step` runs one round —
+
+1. **Expire**: queued or running requests past their deadline are
+   dropped/evicted (the bounded-latency promise: a stuck client cannot
+   pin a slot forever).
+2. **Admit**: FIFO head-of-line from the bounded queue into free engine
+   slots while pages last.  Head-of-line (rather than best-fit over the
+   whole queue) keeps ordering fair — a large request at the head is
+   never starved by small ones slipping past it.
+3. **Tick**: one compiled decode step advances every active slot; each
+   emitted token becomes a ``token`` event, and slots that hit their
+   ``max_new`` budget or the eos token finish.
+
+The scheduler is deliberately free of sockets and metrics: it consumes
+an engine and emits :class:`Event` records, so tests drive it
+synchronously and ``serve.server`` maps events to wire frames and
+gauges.  The admission queue is BOUNDED — :meth:`submit` raises
+:class:`QueueFull` instead of buffering unboundedly, pushing backpressure
+to the client where it belongs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from distlearn_tpu.serve.engine import DecodeEngine
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity — client should back off and retry."""
+
+
+_RIDS = itertools.count(1)
+
+
+@dataclass
+class Request:
+    rid: str
+    prompt: np.ndarray
+    max_new: int
+    deadline: float | None          # absolute clock() value, or None
+    eos: int | None
+    submitted: float                # clock() at submit, for queue-wait spans
+    slot: int | None = None         # engine slot once admitted
+    emitted: int = 0                # tokens emitted so far (incl. first)
+    tokens: list[int] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One scheduling outcome, consumed by the server loop.
+
+    ``kind`` is ``"token"`` (one more token for ``rid``; ``first`` marks
+    the prefill-produced token, i.e. the TTFT edge) or ``"finish"``
+    (``reason`` in ``complete`` / ``eos`` / ``deadline`` / ``cancelled``).
+    """
+    kind: str
+    rid: str
+    token: int | None = None
+    first: bool = False
+    reason: str | None = None
+
+
+class Scheduler:
+    def __init__(self, engine: DecodeEngine, *, max_queue: int = 32,
+                 clock=time.monotonic):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.clock = clock
+        self._queue: deque[Request] = deque()
+        self._running: dict[str, Request] = {}    # rid -> Request
+        self._by_slot: dict[int, Request] = {}
+
+    # -- introspection (server gauges) --------------------------------------
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def active_count(self) -> int:
+        return len(self._running)
+
+    def idle(self) -> bool:
+        return not self._queue and not self._running
+
+    def requests(self) -> list[Request]:
+        return list(self._queue) + list(self._running.values())
+
+    # -- client-facing ------------------------------------------------------
+    def submit(self, prompt, max_new: int, *, rid: str | None = None,
+               deadline_s: float | None = None,
+               eos: int | None = None) -> str:
+        """Enqueue one request; returns its id.  Raises
+        :class:`QueueFull` at capacity and ``ValueError`` for requests
+        the engine could NEVER run (too long even with an empty cache) —
+        those must be rejected here, not left to rot at the queue head."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_new = int(max_new)
+        if prompt.size < 1 or max_new < 1:
+            raise ValueError(f"prompt len {prompt.size} and max_new "
+                             f"{max_new} must be >= 1")
+        if prompt.size + max_new > self.engine.max_len:
+            raise ValueError(
+                f"prompt+max_new = {prompt.size + max_new} exceeds engine "
+                f"max_len {self.engine.max_len}")
+        if len(self._queue) >= self.max_queue:
+            raise QueueFull(f"admission queue at capacity ({self.max_queue})")
+        if rid is None:
+            rid = str(next(_RIDS))
+        now = self.clock()
+        req = Request(rid=rid, prompt=prompt, max_new=max_new,
+                      deadline=(now + deadline_s) if deadline_s else None,
+                      eos=eos, submitted=now)
+        self._queue.append(req)
+        return rid
+
+    def cancel(self, rid: str) -> bool:
+        """Drop a request wherever it is (client disconnected).  Returns
+        False when the rid is unknown / already finished."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                return True
+        req = self._running.pop(rid, None)
+        if req is None:
+            return False
+        del self._by_slot[req.slot]
+        self.engine.finish(req.slot)
+        return True
+
+    # -- one scheduling round ----------------------------------------------
+    def step(self) -> list[Event]:
+        events: list[Event] = []
+        now = self.clock()
+        self._expire(now, events)
+        self._admit(events)
+        self._tick(events)
+        return events
+
+    def _expire(self, now: float, events: list[Event]):
+        # queued requests past deadline never got a slot: drop silently
+        # from the queue but loudly to the client.
+        kept = deque()
+        for req in self._queue:
+            if req.deadline is not None and now >= req.deadline:
+                events.append(Event("finish", req.rid, reason="deadline"))
+            else:
+                kept.append(req)
+        self._queue = kept
+        for req in [r for r in self._running.values()
+                    if r.deadline is not None and now >= r.deadline]:
+            del self._running[req.rid]
+            del self._by_slot[req.slot]
+            self.engine.finish(req.slot)
+            events.append(Event("finish", req.rid, reason="deadline"))
+
+    def _admit(self, events: list[Event]):
+        while self._queue:
+            req = self._queue[0]
+            if not self.engine.has_capacity(req.prompt.size, req.max_new):
+                break
+            self._queue.popleft()
+            slot, first = self.engine.admit(req.prompt, req.max_new)
+            req.slot = slot
+            self._running[req.rid] = req
+            self._by_slot[slot] = req
+            self._emit(req, int(first), events, first_tok=True)
+
+    def _tick(self, events: list[Event]):
+        if not self._running:
+            return
+        for slot, tok in self.engine.tick().items():
+            req = self._by_slot.get(slot)
+            if req is not None:
+                self._emit(req, int(tok), events)
+
+    def _emit(self, req: Request, tok: int, events: list[Event],
+              first_tok: bool = False):
+        req.emitted += 1
+        req.tokens.append(tok)
+        events.append(Event("token", req.rid, token=tok, first=first_tok))
+        done_eos = req.eos is not None and tok == req.eos
+        if req.emitted >= req.max_new or done_eos:
+            del self._running[req.rid]
+            del self._by_slot[req.slot]
+            self.engine.finish(req.slot)
+            events.append(Event("finish", req.rid,
+                                reason="eos" if done_eos else "complete"))

@@ -1,0 +1,259 @@
+"""Decode service front-end: transport loop, telemetry, graceful drain.
+
+Wires the three serving layers to the rest of the repo:
+
+* **Wire**: accepts connections on a ``comm.transport.Server`` and
+  speaks the serving frames — ``'G'`` in (generate request JSON),
+  ``'R'`` out (one token-stream chunk per scheduling round, ``done``
+  flag on the last).  A ``'J'`` control frame answers with a stats
+  snapshot, so health probes share the port.
+* **Telemetry**: ``serve_queue_depth`` / ``serve_active_slots`` gauges,
+  ``serve_ttft_seconds`` / ``serve_tpot_seconds`` histograms (with
+  matching ``serve.ttft`` / ``serve.tpot`` spans in the JSONL trail for
+  ``tools/diststat.py`` percentiles), ``serve_requests_total{outcome}``
+  and ``serve_tokens_total`` counters, and a ``/healthz`` source for the
+  existing obs export thread.
+* **Drain**: :meth:`ServeServer.checkpoint_now` implements the
+  ``ha.install_signal_flush`` contract — on SIGTERM the handler stops
+  admissions, lets in-flight requests decode to completion (bounded by
+  ``drain_timeout``), then lets the signal's prior disposition run.  No
+  new flush machinery: serving reuses the HA hook verbatim.
+
+The request loop runs in ONE thread (foreground ``serve_forever`` or
+background ``start``): sockets are select-ed, the scheduler steps, and
+events fan out to clients.  A client that disconnects mid-stream is
+detected on the failed send and its request cancelled — its slot frees
+on the next round, never leaking pages.
+"""
+
+from __future__ import annotations
+
+import select
+import threading
+import time
+
+import numpy as np
+
+from distlearn_tpu import obs
+from distlearn_tpu.comm import transport
+from distlearn_tpu.comm.transport import PeerClosed, ProtocolError
+from distlearn_tpu.serve.engine import DecodeEngine
+from distlearn_tpu.serve.scheduler import QueueFull, Scheduler
+
+#: TTFT/TPOT buckets (seconds): wider than the wire-latency default —
+#: a prefill at batch-1 on CPU lands in the 10ms..1s decades.
+_LAT_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                1.0, 2.5, 5.0, 10.0)
+
+
+class ServeServer:
+    def __init__(self, engine: DecodeEngine, *, host: str = "127.0.0.1",
+                 port: int = 0, max_queue: int = 32,
+                 default_max_new: int = 32, frame_timeout: float = 5.0,
+                 idle_wait: float = 0.05, drain_timeout: float = 30.0):
+        self.engine = engine
+        self.sched = Scheduler(engine, max_queue=max_queue)
+        self.default_max_new = int(default_max_new)
+        self.frame_timeout = float(frame_timeout)
+        self.idle_wait = float(idle_wait)
+        self.drain_timeout = float(drain_timeout)
+        self._lst = transport.Server(host, port)
+        self.host, self.port = self._lst.host, self._lst.port
+        self._conn_of: dict[str, transport.Conn] = {}   # rid -> client conn
+        self._t_submit: dict[str, float] = {}           # rid -> perf_counter
+        self._t_last: dict[str, float] = {}             # rid -> last token t
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._draining = False
+        self._thread: threading.Thread | None = None
+        self._g_queue = obs.gauge(
+            "serve_queue_depth", "requests waiting for a decode slot")
+        self._g_active = obs.gauge(
+            "serve_active_slots", "requests currently decoding")
+        self._h_ttft = obs.histogram(
+            "serve_ttft_seconds",
+            "time-to-first-token: 'G' frame decoded to first 'R' sent",
+            buckets=_LAT_BUCKETS)
+        self._h_tpot = obs.histogram(
+            "serve_tpot_seconds",
+            "per-output-token latency after the first token",
+            buckets=_LAT_BUCKETS)
+        self._c_reqs = obs.counter(
+            "serve_requests_total", "requests by terminal outcome",
+            labels=("outcome",))
+        self._c_toks = obs.counter(
+            "serve_tokens_total", "tokens streamed to clients")
+        obs.set_health_source(self.health)
+
+    # -- health / introspection --------------------------------------------
+    def health(self) -> dict:
+        return {"serving": not self._stop.is_set(),
+                "draining": self._draining,
+                "queue_depth": self.sched.queue_depth(),
+                "active": self.sched.active_count(),
+                "free_pages": self.engine.cache.free_pages()}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServeServer":
+        """Run the request loop in a background thread (so the main
+        thread stays free for signal handlers — the signal module only
+        delivers to the main thread)."""
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="serve-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    def checkpoint_now(self, wait: bool = True):
+        """Graceful drain under the ``ha.install_signal_flush`` name:
+        the serving analogue of "write one last durable checkpoint" is
+        "finish every admitted request".  Stops admissions immediately;
+        with ``wait`` blocks until in-flight requests complete (or
+        ``drain_timeout`` passes), then stops the loop."""
+        self._draining = True
+        if wait:
+            self._drained.wait(self.drain_timeout)
+        self._stop.set()
+
+    def stop(self):
+        """Immediate shutdown: stop the loop, close every socket.  Safe
+        to call twice and after ``checkpoint_now``."""
+        self._stop.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(10.0)
+        self._thread = None
+        self._lst.close()
+        self._g_queue.set(0)
+        self._g_active.set(0)
+
+    # -- request loop -------------------------------------------------------
+    def serve_forever(self):
+        try:
+            while not self._stop.is_set():
+                self._poll_io()
+                events = self.sched.step()
+                self._dispatch(events)
+                self._g_queue.set(self.sched.queue_depth())
+                self._g_active.set(self.sched.active_count())
+                if self._draining and self.sched.idle():
+                    self._drained.set()
+                    break
+        finally:
+            self._drained.set()
+            self._g_queue.set(0)
+            self._g_active.set(0)
+
+    def _poll_io(self):
+        self._lst.prune_closed()
+        socks = {self._lst.sock: None}
+        for c in self._lst.conns:
+            socks[c.sock] = c
+        # busy (requests decoding) -> poll without blocking between
+        # ticks; idle -> sleep in select until a frame or stop.
+        wait = 0.0 if not self.sched.idle() else self.idle_wait
+        try:
+            ready, _, _ = select.select(list(socks), [], [], wait)
+        except OSError:      # a peer closed between prune and select
+            return
+        for sock in ready:
+            conn = socks[sock]
+            if conn is None:
+                try:
+                    self._lst.accept(timeout=0.0)
+                except (TimeoutError, OSError):
+                    pass
+                continue
+            self._serve_frame(conn)
+
+    def _serve_frame(self, conn: transport.Conn):
+        try:
+            kind, msg = conn.recv_serve(
+                deadline=time.monotonic() + self.frame_timeout)
+        except PeerClosed:
+            self._drop_conn(conn)
+            return
+        except (ConnectionError, ProtocolError, TimeoutError, ValueError):
+            self._drop_conn(conn)
+            return
+        if kind == "J":      # control: health probe / stats over the wire
+            try:
+                conn.send_msg({"ok": True, **self.health()})
+            except OSError:
+                self._drop_conn(conn)
+            return
+        if kind != "G":      # 'R' is server->client only
+            self._drop_conn(conn)
+            return
+        self._submit(conn, msg)
+
+    def _submit(self, conn: transport.Conn, msg):
+        rid = str(msg.get("rid") or "")
+        try:
+            if self._draining:
+                raise QueueFull("server draining")
+            prompt = np.asarray(msg["prompt"], np.int32)
+            rid = self.sched.submit(
+                prompt, int(msg.get("max_new", self.default_max_new)),
+                rid=rid or None,
+                deadline_s=msg.get("deadline_s"),
+                eos=msg.get("eos"))
+        except (QueueFull, ValueError, KeyError, TypeError) as e:
+            self._c_reqs.labels(outcome="rejected").inc()
+            try:
+                conn.send_stream({"rid": rid, "error": str(e) or type(e).__name__,
+                                  "done": True})
+            except OSError:
+                self._drop_conn(conn)
+            return
+        self._conn_of[rid] = conn
+        self._t_submit[rid] = time.perf_counter()
+
+    def _dispatch(self, events):
+        # one 'R' frame per request per round: {"rid", "tokens", "done"[,
+        # "reason"]} — streaming granularity is the tick, matching TTFT.
+        out: dict[str, dict] = {}
+        now = time.perf_counter()
+        for ev in events:
+            chunk = out.setdefault(ev.rid, {"rid": ev.rid, "tokens": [],
+                                            "done": False})
+            if ev.kind == "token":
+                chunk["tokens"].append(ev.token)
+                self._c_toks.inc()
+                if ev.first:
+                    t0 = self._t_submit.get(ev.rid)
+                    if t0 is not None:
+                        self._h_ttft.observe(now - t0)
+                        obs.record_span("serve.ttft", now - t0, rid=ev.rid)
+                else:
+                    tl = self._t_last.get(ev.rid)
+                    if tl is not None:
+                        self._h_tpot.observe(now - tl)
+                        obs.record_span("serve.tpot", now - tl, rid=ev.rid)
+                self._t_last[ev.rid] = now
+            else:
+                chunk["done"] = True
+                chunk["reason"] = ev.reason
+                outcome = ev.reason or "complete"
+                self._c_reqs.labels(outcome=outcome).inc()
+        for rid, chunk in out.items():
+            conn = self._conn_of.get(rid)
+            if conn is not None and conn.sock.fileno() >= 0:
+                try:
+                    conn.send_stream(chunk)
+                except OSError:
+                    self._drop_conn(conn)
+            if chunk["done"]:
+                self._forget(rid)
+
+    def _drop_conn(self, conn: transport.Conn):
+        """Client went away: cancel every request it owns (queued or
+        decoding) so its slot/pages free on the next round."""
+        for rid in [r for r, c in self._conn_of.items() if c is conn]:
+            if self.sched.cancel(rid):
+                self._c_reqs.labels(outcome="cancelled").inc()
+            self._forget(rid)
+        conn.close()
+
+    def _forget(self, rid: str):
+        self._conn_of.pop(rid, None)
+        self._t_submit.pop(rid, None)
+        self._t_last.pop(rid, None)

@@ -15,12 +15,15 @@ Run (8 virtual CPU devices):
     python examples/lm.py --dp 4 --sp 2 --tp 1 --moeExperts 4
 On the attached TPU chip:
     python examples/lm.py --tpu --dp 1 --sp 1 --tp 1 --dim 1024 --depth 8
+Train then serve with continuous batching (docs/SERVING.md; tp>1
+shards the decode tick too; drive with examples/lm_client.py):
+    python examples/lm.py --dp 1 --sp 1 --tp 1 --serve 4 --servePort 9123
 """
 
 from __future__ import annotations
 
 from common import setup_platform
-from distlearn_tpu.utils.flags import parse_flags
+from distlearn_tpu.utils.flags import OBS_FLAGS, parse_flags
 
 
 def main():
@@ -76,9 +79,16 @@ def main():
                         "inserts the gathers (train.build_lm_fsdp_step; "
                         "needs --sp 1 --tp 1, sgd, dense)"),
         "generate": (0, "after training, greedy-decode this many tokens "
-                        "from a held-out prompt with the KV-cached "
+                        "from held-out prompts with the KV-cached "
                         "decoder (models.greedy_generate; single-replica "
                         "param layouts: not --pp/--zero/--fsdp)"),
+        "serve": (0, "after training, serve the model with this many "
+                     "continuous-batching decode slots (distlearn_tpu."
+                     "serve; 'G'/'R' frames, drive with examples/"
+                     "lm_client.py; not --pp/--zero/--fsdp; SIGTERM or "
+                     "Ctrl-C drains in-flight requests then exits)"),
+        "servePort": (0, "serving port (0 = ephemeral, printed at "
+                         "startup)"),
         "optimizer": ("sgd", "sgd | adam | adamw — non-sgd runs the "
                              "replicated-state optax step "
                              "(train.build_lm_optax_step; needs --tp 1)"),
@@ -89,6 +99,7 @@ def main():
         "bf16": (False, "bfloat16 compute"),
         "tpu": (False, "run on the TPU backend"),
         "seed": (0, "init seed"),
+        **OBS_FLAGS,
     })
     remat = opt.rematMode or ("full" if opt.remat else False)
     if opt.seqLayout not in ("contig", "zigzag"):
@@ -135,8 +146,17 @@ def main():
                              "selective mode; the pipeline stage fn "
                              "checkpoints whole blocks — use --remat "
                              "(full) with --pp")
+    if opt.serve and (opt.pp or opt.zero or opt.fsdp):
+        raise SystemExit("--serve needs a single-replica param layout "
+                         "(not --pp/--zero/--fsdp)")
+    if opt.serve and opt.moeExperts:
+        raise SystemExit("--serve supports dense models (per-tick MoE "
+                         "routing would not match the trained capacity "
+                         "math)")
     n_dev = opt.dp * opt.sp * opt.tp * max(1, opt.pp)
     setup_platform(n_dev, opt.tpu)
+    from easgd_common import obs_finish, obs_setup
+    obs_http = obs_setup(opt)
 
     import jax
     import jax.numpy as jnp
@@ -357,12 +377,6 @@ def main():
         if opt.pp or opt.zero or opt.fsdp:
             raise SystemExit("--generate needs a single-replica param "
                              "layout (not --pp/--zero/--fsdp)")
-        if opt.tp > 1 or opt.sp > 1:
-            # the TP/SP-sharded train step leaves each device holding a
-            # projection/sequence shard; the greedy decoder indexes the
-            # full tree on one replica and would decode from a slice
-            raise SystemExit("--generate needs --tp 1 --sp 1 (the "
-                             "defaults are 2 — pass them explicitly)")
         if opt.moeExperts:
             raise SystemExit("--generate supports dense models (per-tick "
                              "MoE routing would not match the trained "
@@ -371,15 +385,58 @@ def main():
             raise SystemExit("--generate decodes in natural order — drop "
                              "--seqLayout zigzag")
         from distlearn_tpu.models import greedy_generate
-        # the trained params: unwrap mixed/optax states to the plain tree
-        p = getattr(params, "params", params)
-        prompt = jnp.asarray(toks[:1, : max(4, opt.seqLen // 8)])
-        gen = greedy_generate(p, prompt,
-                              min(opt.generate,
-                                  opt.seqLen - prompt.shape[1]),
-                              attn_impl=opt.attnImpl or None)
-        log(f"generated {gen.shape[1]} tokens (KV-cached greedy): "
-            f"{np.asarray(gen[0]).tolist()}")
+        # the trained params: unwrap mixed/optax states to the plain
+        # tree, and GATHER any tp/sp-sharded leaves to the host — the
+        # decoder runs single-replica regardless of the train mesh
+        p = jax.device_get(getattr(params, "params", params))
+        Pq = max(4, opt.seqLen // 8)
+        steps = min(opt.generate, opt.seqLen - Pq)
+        # two prompts of different lengths, left-padded to Pq: the
+        # batched ragged path (prompt_lens) in one call
+        plens = np.array([Pq, max(2, Pq // 2)], np.int32)
+        prompts = np.zeros((2, Pq), np.int32)
+        for b, L in enumerate(plens):
+            prompts[b, Pq - L:] = toks[b % toks.shape[0], :L]
+        gen = greedy_generate(p, jnp.asarray(prompts), steps,
+                              attn_impl=opt.attnImpl or None,
+                              prompt_lens=plens)
+        for b, L in enumerate(plens):
+            log(f"generated {gen.shape[1]} tokens (KV-cached greedy, "
+                f"prompt len {L}): {np.asarray(gen[b]).tolist()}")
+    if opt.serve:
+        from distlearn_tpu.parallel.ha import install_signal_flush
+        from distlearn_tpu.serve import DecodeEngine, ServeServer
+        p = jax.device_get(getattr(params, "params", params))
+        mesh_kw = {}
+        if opt.tp > 1:
+            # serve tp-sharded over a dedicated ("model",) submesh: the
+            # decode tick is one jit/shard_map program, psums and all
+            from jax.sharding import Mesh as _Mesh
+            mesh_kw = {"mesh": _Mesh(np.array(jax.devices()[:opt.tp]),
+                                     ("model",)),
+                       "tp_axis": "model"}
+        engine = DecodeEngine(p, num_slots=opt.serve, **mesh_kw)
+        # warm the smallest prefill bucket + the tick program so the
+        # first real request's TTFT is a tick, not a compile
+        _slot, _ = engine.admit(np.ones(4, np.int32), 2)
+        engine.tick()
+        engine.finish(_slot)
+        srv = ServeServer(engine, port=opt.servePort).start()
+        install_signal_flush(srv)    # SIGTERM -> drain, then exit
+        log(f"serving on {srv.host}:{srv.port} "
+            f"({opt.serve} slots, max_len {engine.max_len}"
+            + (f", tp={opt.tp}" if opt.tp > 1 else "") + ") — "
+            f"drive with: python examples/lm_client.py "
+            f"--port {srv.port}")
+        try:
+            while srv._thread is not None and srv._thread.is_alive():
+                srv._thread.join(0.5)
+        except KeyboardInterrupt:
+            log("draining...")
+            srv.checkpoint_now(wait=True)
+        srv.stop()
+        log("serve drained")
+    obs_finish(opt, obs_http)
     log("done")
 
 

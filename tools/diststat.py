@@ -208,6 +208,40 @@ def failover_table(counter_totals: dict, counters: dict,
     return tab
 
 
+_SERVE_SPANS = {"serve.ttft": "ttft", "serve.tpot": "tpot",
+                "serve.prefill": "prefill", "serve.tick": "tick"}
+_SERVE_OUTCOMES = 'serve_requests_total{outcome="'
+
+
+def serving_table(counter_totals: dict, counters: dict, spans: dict) -> dict:
+    """Derive the serving table (docs/SERVING.md): request counts by
+    terminal outcome, tokens streamed, and TTFT / per-token (TPOT) /
+    prefill / tick latency quantiles from the span trail — exact values
+    from individual spans, not histogram buckets.  Empty when the run
+    served nothing."""
+    tab: dict = {}
+    outcomes = {}
+    for key, v in counters.items():
+        if key.startswith(_SERVE_OUTCOMES) and key.endswith('"}'):
+            outcomes[key[len(_SERVE_OUTCOMES):-2]] = v
+    if outcomes:
+        tab["requests"] = dict(sorted(outcomes.items()))
+    toks = counter_totals.get("serve_tokens_total", 0)
+    if toks:
+        tab["tokens"] = toks
+    lat = {}
+    for name, col in _SERVE_SPANS.items():
+        durs = spans.get(name)
+        if durs:
+            lat[col] = {"count": len(durs),
+                        "p50": _percentile(durs, 50),
+                        "p95": _percentile(durs, 95),
+                        "p99": _percentile(durs, 99)}
+    if lat:
+        tab["latency"] = lat
+    return tab
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -232,7 +266,9 @@ def summarize_run(paths: list[str]) -> dict:
             "wire": wire_table(run["counters"]),
             "shards": shard_table(run["counters"], run["histograms"]),
             "failover": failover_table(run["counter_totals"],
-                                       run["counters"], run["spans"])}
+                                       run["counters"], run["spans"]),
+            "serving": serving_table(run["counter_totals"],
+                                     run["counters"], run["spans"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -336,6 +372,24 @@ def _print_summary(doc: dict):
         for name, row in fo.get("latency", {}).items():
             print(f"  {name}: count={row['count']} "
                   f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
+        print()
+    if doc.get("serving"):
+        sv = doc["serving"]
+        print("serving:")
+        for outcome, v in sv.get("requests", {}).items():
+            print(f"  requests[{outcome}] = {v:g}")
+        if "tokens" in sv:
+            print(f"  tokens = {sv['tokens']:g}")
+        if sv.get("latency"):
+            print(f"  {'':<8} {'count':>7} {'p50':>10} {'p95':>10} "
+                  f"{'p99':>10}")
+            for col in ("ttft", "tpot", "prefill", "tick"):
+                row = sv["latency"].get(col)
+                if row:
+                    print(f"  {col:<8} {row['count']:>7} "
+                          f"{_fmt_s(row['p50']):>10} "
+                          f"{_fmt_s(row['p95']):>10} "
+                          f"{_fmt_s(row['p99']):>10}")
 
 
 def _print_diff(doc: dict):
