@@ -49,6 +49,10 @@ _THDR = struct.Struct("<I")   # tensor header length
 # longer buffer lists loop.
 _IOV_MAX = 512
 
+#: recv_serve_nowait frame-size cap — serve payloads are small JSON, so
+#: anything bigger is a desynced or hostile peer.
+SERVE_MAX_FRAME = 1 << 20
+
 _CONN_IDS = itertools.count()
 
 
@@ -115,6 +119,8 @@ class Conn:
         self.bytes_sent = 0
         self.bytes_received = 0
         self.throttle_bps: float | None = None
+        self._rx = bytearray()        # recv_serve_nowait partial-frame buffer
+        self._rx_eof = False
         # Telemetry handles resolve once per connection (obs.NULL when the
         # kill switch is off, so the hot path stays a no-op method call).
         # Counters mirror bytes_sent/bytes_received exactly: both are
@@ -326,6 +332,69 @@ class Conn:
         if self._obs:
             self._h_serve.observe(time.perf_counter() - t0)
         return chr(kind), json.loads(payload)
+
+    def rx_pending(self) -> int:
+        """Bytes of a partial serve frame buffered by
+        :meth:`recv_serve_nowait` — nonzero means the peer has a frame in
+        flight, so a server loop can time out tricklers without ever
+        blocking on them."""
+        return len(self._rx)
+
+    def recv_serve_nowait(self) -> list[tuple[str, Any]]:
+        """Drain whatever bytes the socket holds RIGHT NOW — never
+        blocking — reassemble them, and return every COMPLETE serve
+        frame as ``(kind, msg)`` pairs (possibly none).  A partial frame
+        stays buffered on the connection until the peer's next bytes
+        arrive.
+
+        The single-threaded-server counterpart of :meth:`recv_serve`:
+        select only proves SOME bytes are readable, and a blocking
+        whole-frame read there lets one half-sent frame stall every
+        other in-flight request (head-of-line blocking).  Raises
+        :class:`PeerClosed` on EOF at a frame boundary,
+        :class:`ConnectionResetError` on EOF mid-frame, and
+        :class:`ProtocolError` on a non-serve kind or a frame larger
+        than :data:`SERVE_MAX_FRAME` (buffering an attacker-announced
+        length would hand the peer a memory lever)."""
+        got = 0
+        self.sock.setblocking(False)
+        try:
+            while True:
+                try:
+                    chunk = self.sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    break
+                if not chunk:
+                    self._rx_eof = True
+                    break
+                self._rx += chunk
+                got += len(chunk)
+        finally:
+            try:
+                self.sock.setblocking(True)
+            except OSError:
+                pass
+        if got:
+            self.bytes_received += got
+            self._m_recv.inc(got)
+        frames: list[tuple[str, Any]] = []
+        while len(self._rx) >= _HDR.size:
+            kind, length = _HDR.unpack_from(self._rx)
+            if kind not in (ord("G"), ord("R"), ord("J")):
+                raise ProtocolError(
+                    f"expected serve frame (G/R/J), got kind {chr(kind)!r}")
+            if length > SERVE_MAX_FRAME:
+                raise ProtocolError(f"serve frame too large: {length} bytes")
+            if len(self._rx) < _HDR.size + length:
+                break
+            payload = bytes(self._rx[_HDR.size:_HDR.size + length])
+            del self._rx[:_HDR.size + length]
+            frames.append((chr(kind), json.loads(payload)))
+        if self._rx_eof and not frames:
+            if self._rx:
+                raise ConnectionResetError("peer closed connection mid-frame")
+            raise PeerClosed("peer closed connection")
+        return frames
 
     # -- tensors ------------------------------------------------------------
     def send_tensor(self, arr: np.ndarray):

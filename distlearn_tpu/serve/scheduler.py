@@ -93,6 +93,9 @@ class Scheduler:
     def requests(self) -> list[Request]:
         return list(self._queue) + list(self._running.values())
 
+    def _live(self, rid: str) -> bool:
+        return rid in self._running or any(r.rid == rid for r in self._queue)
+
     # -- client-facing ------------------------------------------------------
     def submit(self, prompt, max_new: int, *, rid: str | None = None,
                deadline_s: float | None = None,
@@ -100,7 +103,11 @@ class Scheduler:
         """Enqueue one request; returns its id.  Raises
         :class:`QueueFull` at capacity and ``ValueError`` for requests
         the engine could NEVER run (too long even with an empty cache) —
-        those must be rejected here, not left to rot at the queue head."""
+        those must be rejected here, not left to rot at the queue head —
+        and for a ``rid`` already queued or running: the bookkeeping is
+        rid-keyed, so a second live request under the same id would
+        overwrite the first's entry and corrupt event routing (a rid
+        becomes reusable once its request finishes)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         max_new = int(max_new)
         if prompt.size < 1 or max_new < 1:
@@ -114,9 +121,15 @@ class Scheduler:
             raise QueueFull(f"admission queue at capacity ({self.max_queue})")
         if rid is None:
             rid = str(next(_RIDS))
+            while self._live(rid):      # a client squatted on this numeral
+                rid = str(next(_RIDS))
+        elif self._live(rid):
+            raise ValueError(f"duplicate rid {rid!r}: already queued or "
+                             "running")
         now = self.clock()
         req = Request(rid=rid, prompt=prompt, max_new=max_new,
-                      deadline=(now + deadline_s) if deadline_s else None,
+                      deadline=(now + deadline_s) if deadline_s is not None
+                      else None,
                       eos=eos, submitted=now)
         self._queue.append(req)
         return rid

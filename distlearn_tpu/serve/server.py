@@ -21,9 +21,13 @@ Wires the three serving layers to the rest of the repo:
 
 The request loop runs in ONE thread (foreground ``serve_forever`` or
 background ``start``): sockets are select-ed, the scheduler steps, and
-events fan out to clients.  A client that disconnects mid-stream is
-detected on the failed send and its request cancelled — its slot frees
-on the next round, never leaking pages.
+events fan out to clients.  Reads never block — frames reassemble
+per-connection from whatever bytes are available
+(``Conn.recv_serve_nowait``), so a peer that half-sends a frame cannot
+head-of-line block the decode loop; ``frame_timeout`` bounds how long a
+partial frame may linger before the trickler is dropped.  A client that
+disconnects mid-stream is detected on the failed send and its request
+cancelled — its slot frees on the next round, never leaking pages.
 """
 
 from __future__ import annotations
@@ -31,14 +35,16 @@ from __future__ import annotations
 import select
 import threading
 import time
+import traceback
 
 import numpy as np
 
 from distlearn_tpu import obs
 from distlearn_tpu.comm import transport
-from distlearn_tpu.comm.transport import PeerClosed, ProtocolError
+from distlearn_tpu.comm.transport import ProtocolError
 from distlearn_tpu.serve.engine import DecodeEngine
 from distlearn_tpu.serve.scheduler import QueueFull, Scheduler
+from distlearn_tpu.utils.logging import print_server
 
 #: TTFT/TPOT buckets (seconds): wider than the wire-latency default —
 #: a prefill at batch-1 on CPU lands in the 10ms..1s decades.
@@ -62,6 +68,8 @@ class ServeServer:
         self._conn_of: dict[str, transport.Conn] = {}   # rid -> client conn
         self._t_submit: dict[str, float] = {}           # rid -> perf_counter
         self._t_last: dict[str, float] = {}             # rid -> last token t
+        self._rx_since: dict[transport.Conn, float] = {}  # partial-frame age
+        self._failed: str | None = None                 # loop death, if any
         self._stop = threading.Event()
         self._drained = threading.Event()
         self._draining = False
@@ -88,6 +96,7 @@ class ServeServer:
     # -- health / introspection --------------------------------------------
     def health(self) -> dict:
         return {"serving": not self._stop.is_set(),
+                "failed": self._failed,
                 "draining": self._draining,
                 "queue_depth": self.sched.queue_depth(),
                 "active": self.sched.active_count(),
@@ -129,14 +138,26 @@ class ServeServer:
     def serve_forever(self):
         try:
             while not self._stop.is_set():
-                self._poll_io()
-                events = self.sched.step()
-                self._dispatch(events)
-                self._g_queue.set(self.sched.queue_depth())
-                self._g_active.set(self.sched.active_count())
-                if self._draining and self.sched.idle():
-                    self._drained.set()
-                    break
+                try:
+                    self._poll_io()
+                    events = self.sched.step()
+                    self._dispatch(events)
+                    self._g_queue.set(self.sched.queue_depth())
+                    self._g_active.set(self.sched.active_count())
+                    if self._draining and self.sched.idle():
+                        self._drained.set()
+                        break
+                except Exception as e:  # noqa: BLE001 — death must be seen
+                    # an unexpected scheduler/engine error must not kill
+                    # this thread silently while health() keeps saying
+                    # serving=True and clients hang to their timeouts:
+                    # record it, flip health, fail the clients fast.
+                    self._failed = repr(e)
+                    print_server("serve loop died:",
+                                 traceback.format_exc())
+                    self._stop.set()
+                    for c in list(self._lst.conns):
+                        c.close()
         finally:
             self._drained.set()
             self._g_queue.set(0)
@@ -162,28 +183,54 @@ class ServeServer:
                 except (TimeoutError, OSError):
                     pass
                 continue
-            self._serve_frame(conn)
+            self._serve_conn(conn)
+        self._reap_stalled()
 
-    def _serve_frame(self, conn: transport.Conn):
+    def _serve_conn(self, conn: transport.Conn):
+        """Drain the connection WITHOUT blocking and handle every frame
+        that completed: select only proves some bytes arrived, so a
+        blocking whole-frame read here would let one half-sent frame
+        stall scheduling for every in-flight request (head-of-line
+        blocking).  Partial frames stay buffered on the Conn; a peer
+        that leaves one buffered longer than ``frame_timeout`` is
+        dropped by :meth:`_reap_stalled`."""
         try:
-            kind, msg = conn.recv_serve(
-                deadline=time.monotonic() + self.frame_timeout)
-        except PeerClosed:
+            frames = conn.recv_serve_nowait()
+        except (OSError, ProtocolError, ValueError):
+            # PeerClosed (clean FIN), a torn frame, a non-serve kind, or
+            # undecodable JSON: the stream cannot be resumed.
             self._drop_conn(conn)
             return
-        except (ConnectionError, ProtocolError, TimeoutError, ValueError):
-            self._drop_conn(conn)
-            return
-        if kind == "J":      # control: health probe / stats over the wire
-            try:
-                conn.send_msg({"ok": True, **self.health()})
-            except OSError:
+        if conn.rx_pending():
+            self._rx_since.setdefault(conn, time.monotonic())
+        else:
+            self._rx_since.pop(conn, None)
+        for kind, msg in frames:
+            if conn.sock.fileno() < 0:   # dropped handling an earlier frame
+                return
+            if kind == "J":  # control: health probe / stats over the wire
+                try:
+                    conn.send_msg({"ok": True, **self.health()})
+                except OSError:
+                    self._drop_conn(conn)
+                    return
+            elif kind == "G":
+                self._submit(conn, msg)
+            else:            # 'R' is server->client only
                 self._drop_conn(conn)
+                return
+
+    def _reap_stalled(self):
+        """Drop connections whose partial frame has been sitting in the
+        reassembly buffer longer than ``frame_timeout`` — the trickler
+        wedge class the old blocking deadline killed, now enforced
+        without letting the trickler block anyone."""
+        if not self._rx_since:
             return
-        if kind != "G":      # 'R' is server->client only
+        now = time.monotonic()
+        for conn in [c for c, t0 in self._rx_since.items()
+                     if now - t0 > self.frame_timeout]:
             self._drop_conn(conn)
-            return
-        self._submit(conn, msg)
 
     def _submit(self, conn: transport.Conn, msg):
         rid = str(msg.get("rid") or "")
@@ -251,6 +298,7 @@ class ServeServer:
             if self.sched.cancel(rid):
                 self._c_reqs.labels(outcome="cancelled").inc()
             self._forget(rid)
+        self._rx_since.pop(conn, None)
         conn.close()
 
     def _forget(self, rid: str):

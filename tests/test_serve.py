@@ -267,6 +267,51 @@ def test_scheduler_cancel(lm_params):
     assert eng.cache.free_pages() == eng.cache.num_pages - 1
 
 
+def test_scheduler_duplicate_rid_rejected(lm_params):
+    """A rid colliding with a QUEUED or RUNNING request is rejected at
+    submit — the bookkeeping is rid-keyed, so a second live request
+    under the same id would overwrite the first's entry, cross the two
+    streams, and KeyError the scheduler when the survivor finishes.  A
+    finished rid is reusable, and auto-assigned ids skip numerals a
+    client squatted on."""
+    import distlearn_tpu.serve.scheduler as sched_mod
+    from distlearn_tpu.serve.engine import DecodeEngine
+    from distlearn_tpu.serve.scheduler import Scheduler
+    eng = DecodeEngine(lm_params, num_slots=1, max_len=MAX_LEN, page=8)
+    sched = Scheduler(eng, max_queue=8)
+    p = _prompts(1)[0]
+    sched.submit(p, 4, rid="dup")
+    with pytest.raises(ValueError, match="duplicate"):
+        sched.submit(p, 4, rid="dup")      # collides while queued
+    sched.step()                           # admitted -> running
+    with pytest.raises(ValueError, match="duplicate"):
+        sched.submit(p, 4, rid="dup")      # collides while running
+    squat = str(next(sched_mod._RIDS) + 1)
+    sched.submit(p, 4, rid=squat)
+    assert sched.submit(p, 4) != squat     # auto id skips the squat
+    while not sched.idle():
+        sched.step()
+    assert sched.submit(p, 4, rid="dup") == "dup"   # finished: reusable
+    while not sched.idle():
+        sched.step()
+    eng.cache.check()
+
+
+def test_scheduler_deadline_zero_expires_immediately(lm_params):
+    """deadline_s=0 is an already-expired deadline, not 'no deadline' —
+    a falsy zero must not disable the deadline the client asked for."""
+    from distlearn_tpu.serve.engine import DecodeEngine
+    from distlearn_tpu.serve.scheduler import Scheduler
+    eng = DecodeEngine(lm_params, num_slots=1, max_len=MAX_LEN, page=8)
+    now = [100.0]
+    sched = Scheduler(eng, max_queue=4, clock=lambda: now[0])
+    p = _prompts(1)[0]
+    rid = sched.submit(p, 4, deadline_s=0.0)
+    assert any(e.kind == "finish" and e.rid == rid
+               and e.reason == "deadline" for e in sched.step())
+    assert sched.idle()
+
+
 # -- wire frames --------------------------------------------------------------
 
 def test_transport_serve_frames():
@@ -377,6 +422,138 @@ def test_e2e_sigterm_drain_contract(lm_params):
         assert not t.is_alive()
         assert out["r"]["tokens"] == ref   # drained, not cut off
         assert out["r"]["reason"] == "complete"
+    finally:
+        srv.stop()
+
+
+# -- hostile/broken clients must not hurt anyone else -------------------------
+
+def _pump(srv):
+    """One serve_forever round, driven synchronously by the test."""
+    srv._poll_io()
+    srv._dispatch(srv.sched.step())
+
+
+def test_e2e_duplicate_rid_rejected(lm_params):
+    """A client-chosen rid colliding with a LIVE request is rejected
+    with an error chunk; the victim's stream completes token-exact and
+    the loop survives (a remote client must not be able to corrupt
+    rid-keyed routing or crash the service).  Driven synchronously so
+    the collision window is deterministic."""
+    import select
+    from distlearn_tpu.comm import transport
+    from distlearn_tpu.serve import DecodeEngine, ServeServer
+    p = _prompts(1, seed=21)[0]
+    max_new = 6
+    ref = _greedy_ref(lm_params, p, max_new)
+    eng = DecodeEngine(lm_params, num_slots=2, max_len=MAX_LEN, page=8)
+    srv = ServeServer(eng, idle_wait=0.01)     # not started: test pumps
+    try:
+        c1 = transport.connect(srv.host, srv.port)
+        c2 = transport.connect(srv.host, srv.port)
+        gen = {"prompt": p.tolist(), "max_new": max_new, "rid": "same"}
+        c1.send_gen(gen)
+        deadline = time.monotonic() + 30
+        while not any(r.rid == "same" for r in srv.sched.requests()):
+            assert time.monotonic() < deadline
+            _pump(srv)
+        c2.send_gen(gen)                       # collides while live
+        # io-only rounds: "same" cannot finish before the collision lands
+        while not select.select([c2.sock], [], [], 0.0)[0]:
+            assert time.monotonic() < deadline
+            srv._poll_io()
+        kind, chunk = c2.recv_serve(deadline=time.monotonic() + 5)
+        assert kind == "R" and chunk["done"]
+        assert "duplicate" in chunk["error"]
+        while not srv.sched.idle():            # victim decodes to the end
+            assert time.monotonic() < deadline
+            _pump(srv)
+        toks, reason = [], None
+        while reason is None:
+            kind, chunk = c1.recv_serve(deadline=time.monotonic() + 5)
+            assert kind == "R" and not chunk.get("error")
+            toks += chunk.get("tokens") or []
+            if chunk.get("done"):
+                reason = chunk["reason"]
+        assert toks == ref and reason == "complete"
+        c1.close()
+        c2.close()
+    finally:
+        srv.stop()
+
+
+def test_partial_frame_no_head_of_line_blocking(lm_params):
+    """A peer that half-sends a frame and stalls must not stall anyone
+    else: the old blocking whole-frame read wedged the single-threaded
+    loop for frame_timeout per readiness event.  With buffered
+    reassembly the other client's request completes immediately — and
+    the stalled frame still decodes once its remaining bytes arrive."""
+    import json
+    import struct
+    from distlearn_tpu.comm import transport
+    from distlearn_tpu.serve import ServeClient
+    p_slow, p_fast = _prompts(2, seed=17)
+    max_new = 4
+    ref_slow = _greedy_ref(lm_params, p_slow, max_new)
+    ref_fast = _greedy_ref(lm_params, p_fast, max_new)
+    srv = _serve_server(lm_params, frame_timeout=60.0)
+    try:
+        payload = json.dumps({"prompt": p_slow.tolist(),
+                              "max_new": max_new, "rid": "slow"}).encode()
+        frame = struct.pack("<BQ", ord("G"), len(payload)) + payload
+        half = transport.connect(srv.host, srv.port)
+        half.sock.sendall(frame[:5])           # half a header, then stall
+        time.sleep(0.1)                        # server has seen the bytes
+        with ServeClient(srv.host, srv.port) as c:
+            # frame_timeout (60s) > client timeout (20s): with the old
+            # blocking read this request could never finish in time
+            r = c.generate(p_fast, max_new, rid="fast", timeout=20)
+        assert r["tokens"] == ref_fast
+        half.sock.sendall(frame[5:])           # complete the stalled frame
+        toks = []
+        while True:
+            kind, chunk = half.recv_serve(deadline=time.monotonic() + 30)
+            assert kind == "R" and not chunk.get("error")
+            toks += chunk.get("tokens") or []
+            if chunk.get("done"):
+                break
+        assert toks == ref_slow                # reassembled and served
+        half.close()
+    finally:
+        srv.stop()
+
+
+def test_trickler_dropped_after_frame_timeout(lm_params):
+    """A partial frame older than frame_timeout gets its connection
+    dropped — the trickler wedge is bounded without ever blocking."""
+    from distlearn_tpu.comm import transport
+    srv = _serve_server(lm_params, frame_timeout=0.3)
+    try:
+        trick = transport.connect(srv.host, srv.port)
+        trick.sock.sendall(b"G\x10")           # 2 bytes of a 9-byte header
+        with pytest.raises(ConnectionError):
+            trick.recv_serve(deadline=time.monotonic() + 10)
+        trick.close()
+    finally:
+        srv.stop()
+
+
+def test_serve_loop_failure_observable(lm_params):
+    """An unexpected scheduler/engine error must not kill the loop
+    thread silently: health() flips to serving=False and records the
+    failure, so probes see the death instead of serving=True forever."""
+    srv = _serve_server(lm_params)
+    try:
+        assert srv.health()["serving"] and srv.health()["failed"] is None
+
+        def boom():
+            raise RuntimeError("boom")
+
+        srv.sched.step = boom
+        srv._thread.join(10)
+        assert not srv._thread.is_alive()
+        h = srv.health()
+        assert not h["serving"] and "boom" in h["failed"]
     finally:
         srv.stop()
 
