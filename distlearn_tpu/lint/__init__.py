@@ -15,6 +15,16 @@ vocabulary:
   peak memory from the HLO (DL201–DL202);
   :mod:`distlearn_tpu.lint.budget` gates those numbers against committed
   per-family lockfiles (DL203–DL205).
+* :mod:`distlearn_tpu.lint.model` — explicit-state model checking: BFS
+  over ALL interleavings (with crash/drop/FIN faults) of small process
+  models of the AsyncEA sync, sharded, replay, failover, and serve
+  protocols, checking deadlock-freedom, epoch fencing, exactly-once, and
+  resource conservation at every state (DL301–DL304).
+* :mod:`distlearn_tpu.lint.races` — Eraser-style static lockset race
+  detection over the threaded modules (DL111/DL112).
+* :mod:`distlearn_tpu.lint.conformance` — pins the hand-written protocol
+  schedules to the wire constants and call sites of the code they model
+  (DL310).
 
 ``tools/distlint.py`` is the CLI front end; ``lint.registry`` names the
 repo's step-function families so CI can lint all of them in one call.
@@ -24,7 +34,12 @@ from distlearn_tpu.lint.core import Finding, RULES, format_findings
 from distlearn_tpu.lint.spmd import lint_step, lint_jaxpr
 from distlearn_tpu.lint.cost import CollectiveOp, CostReport, analyze_step
 from distlearn_tpu.lint.budget import check_family, load_budget, save_budget
+from distlearn_tpu.lint.conformance import lint_conformance
+from distlearn_tpu.lint.model import ModelSpec, check_model, lint_models
+from distlearn_tpu.lint.races import lint_races
 
 __all__ = ["Finding", "RULES", "format_findings", "lint_step", "lint_jaxpr",
            "CollectiveOp", "CostReport", "analyze_step",
-           "check_family", "load_budget", "save_budget"]
+           "check_family", "load_budget", "save_budget",
+           "ModelSpec", "check_model", "lint_models",
+           "lint_races", "lint_conformance"]

@@ -1,0 +1,286 @@
+"""Schedule↔code conformance (rule DL310).
+
+The ``async_ea_*_schedule`` builders in ``lint/protocol.py`` are
+hand-written transcriptions of the blocking send/recv sequences in
+``parallel/async_ea.py`` — which means they can silently drift from the
+code they claim to model, and every DL101/DL104 verdict downstream of a
+drifted schedule is a verdict about a protocol nobody runs.  This module
+pins the two together:
+
+* **Tag vocabulary** — every send/recv tag a schedule uses must be bound
+  in :data:`TAG_BINDINGS` to its origin: a wire-protocol constant in
+  ``async_ea.py`` (existence AND value are checked against the module
+  source, so renaming ``DELTA_Q`` or changing its string breaks
+  conformance, not just the schedules), a reply-dict key (``stale``), a
+  tensor/packed stream leg, or a synthetic scheduling marker (``go``).
+  An unbound tag — the classic "edited the schedule, not the code"
+  mutation — is DL310.
+* **Usage evidence** — each bound constant must actually be *used* (a
+  ``Load`` beyond its definition) in ``async_ea.py``, and the handshake
+  call sites the schedules transcribe must exist: ``_rejoin_handshake``
+  sends ``ACK``, ``_replay_exchange`` opens with a ``REPLAY_Q`` dict
+  send, ``_refuse_stale`` sends a reply carrying the ``stale`` key.
+* **Question order** — ``sync_client`` sends ``Center?`` before
+  ``delta?`` (the fetch-then-push EASGD round).  The first-send order is
+  extracted from the code's AST and every schedule rank that sends both
+  must agree — swapping ``client_order`` in a schedule (or the code) is
+  DL310 here before it is a DL104 desync in the simulator.
+* **Coverage** — every ``*_Q`` message-type constant the code defines
+  must appear in some schedule, except those in
+  :data:`KNOWN_UNMODELED` (with a recorded reason), so a NEW message
+  type cannot ship without either a schedule or a conscious exemption.
+
+``lint_conformance(schedules=..., source=...)`` accepts overrides so the
+seeded-mutation tests can feed in an edited schedule or edited module
+source and assert DL310 fires.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+from typing import Mapping
+
+from distlearn_tpu.lint.core import Finding
+
+__all__ = ["lint_conformance", "TAG_BINDINGS", "KNOWN_UNMODELED"]
+
+#: tag -> (kind, detail).  Kinds:
+#:   "const"     — wire constant in async_ea.py; detail = const name;
+#:                 value must equal the tag exactly
+#:   "const_ci"  — same, but schedules use the wire's lowercase form
+#:   "key"       — reply-dict key; detail = the key literal
+#:   "stream"    — tensor/packed payload leg, no msg-tag constant
+#:   "synthetic" — scheduling marker with no wire message at all
+TAG_BINDINGS: dict = {
+    "Enter?": ("const", "ENTER_Q"),
+    "Enter": ("const", "ENTER"),
+    "Center?": ("const", "CENTER_Q"),
+    "delta?": ("const", "DELTA_Q"),
+    "delta": ("const", "DELTA"),
+    "Rejoin?": ("const", "REJOIN_Q"),
+    "Rejoin": ("const", "REJOIN"),
+    "Shard?": ("const", "SHARD_Q"),
+    "Replay": ("const", "REPLAY_Q"),
+    "ack": ("const_ci", "ACK"),
+    "stale": ("key", "stale"),
+    "center": ("stream", "per-leaf center tensor leg (send_tensors)"),
+    "center_p": ("stream", "packed center frame (send_packed)"),
+    "delta_t": ("stream", "per-leaf delta tensor leg"),
+    "delta_p": ("stream", "packed delta frame"),
+    "replay_p": ("stream", "replay stripe payload frame"),
+    "go": ("synthetic", "client-side thread fan-out marker — models the "
+                        "stripe-leg spawn order, never hits the wire"),
+}
+
+#: ``*_Q`` message types the code defines that no schedule models, each
+#: with the reason the gap is deliberate.
+KNOWN_UNMODELED: dict = {
+    "TEST_Q": "test_net() is a standalone health RPC, not part of any "
+              "sync/rejoin/failover round the schedules transcribe",
+}
+
+#: (function, constant) send call sites the schedules transcribe.
+_CALLSITE_EVIDENCE = (
+    ("_rejoin_handshake", "ACK",
+     "the rejoin center-stream ack leg (schedules' 'ack' after 'center')"),
+    ("_replay_exchange", "REPLAY_Q",
+     "the replay announcement (schedules' 'Replay' op)"),
+)
+
+
+class _CodeFacts(ast.NodeVisitor):
+    """Module-level constants, per-name Load counts, and per-function
+    ``send_msg`` call summaries for one module's AST."""
+
+    def __init__(self):
+        self.consts: dict[str, object] = {}
+        self.loads: dict[str, int] = {}
+        #: function name -> ordered list of send descriptors:
+        #:   ("const", NAME) for send_msg(NAME)
+        #:   ("keys", frozenset) for send_msg({...literal dict...})
+        self.sends: dict[str, list] = {}
+        self._func: list[str] = []
+
+    def visit_Assign(self, node):
+        if not self._func:
+            for t in node.targets:
+                if (isinstance(t, ast.Name)
+                        and isinstance(node.value, ast.Constant)):
+                    self.consts[t.id] = node.value.value
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self._func.append(node.name)
+        self.sends.setdefault(node.name, [])
+        self.generic_visit(node)
+        self._func.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.loads[node.id] = self.loads.get(node.id, 0) + 1
+        self.generic_visit(node)
+
+    def _record_send(self, desc):
+        # credit every enclosing scope: sync_client's wire traffic lives
+        # in its _fetch/_push closures, and lexical definition order of
+        # those closures matches their call order in the round
+        for fname in self._func:
+            self.sends[fname].append(desc)
+
+    def visit_Call(self, node):
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "send_msg" and self._func
+                and node.args):
+            a = node.args[0]
+            if isinstance(a, ast.Name):
+                self._record_send(("const", a.id))
+            elif isinstance(a, ast.Dict):
+                keys, qconst = set(), None
+                for k, v in zip(a.keys, a.values):
+                    if isinstance(k, ast.Constant):
+                        keys.add(k.value)
+                        if (k.value == "q" and isinstance(v, ast.Name)):
+                            qconst = v.id
+                if qconst is not None:
+                    self._record_send(("const", qconst))
+                self._record_send(("keys", frozenset(keys)))
+        self.generic_visit(node)
+
+
+def _schedule_tags(sched: Mapping):
+    """Yield (rank, op) for every op in a schedule dict."""
+    for rank, ops in sched.items():
+        for op in ops:
+            yield rank, op
+
+
+def _default_schedules() -> dict:
+    from distlearn_tpu.lint import protocol
+    out = {}
+    for name in dir(protocol):
+        if name.startswith("async_ea_") and name.endswith("_schedule"):
+            out[name] = getattr(protocol, name)()
+    return out
+
+
+def lint_conformance(*, schedules: Mapping | None = None,
+                     source: str | None = None) -> list[Finding]:
+    """DL310 audit: diff every hand-written ``async_ea_*`` schedule
+    against the wire constants and call sites in ``async_ea.py``.
+
+    ``schedules`` maps schedule name -> per-rank op dict (default: every
+    ``async_ea_*_schedule`` builder at its default arity); ``source``
+    overrides the ``async_ea.py`` module source (mutation tests).
+    """
+    if schedules is None:
+        schedules = _default_schedules()
+    if source is None:
+        from distlearn_tpu.parallel import async_ea
+        source = inspect.getsource(async_ea)
+    facts = _CodeFacts()
+    facts.visit(ast.parse(source))
+    findings: list[Finding] = []
+
+    # -- 1. every schedule tag is bound, and const bindings hold ------------
+    used_consts: set[str] = set()
+    for sname, sched in schedules.items():
+        for rank, op in _schedule_tags(sched):
+            tag = op.tag
+            binding = TAG_BINDINGS.get(tag)
+            where = f"{sname}:{rank}"
+            if binding is None:
+                findings.append(Finding(
+                    "DL310",
+                    f"schedule op {op.kind}({op.peer!r}, {tag!r}) uses a "
+                    f"tag bound to nothing in async_ea.py — the schedule "
+                    f"drifted from the code (or the binding table needs "
+                    f"a new entry with evidence)", where=where))
+                continue
+            kind, detail = binding
+            if kind in ("const", "const_ci"):
+                used_consts.add(detail)
+                val = facts.consts.get(detail)
+                if val is None:
+                    findings.append(Finding(
+                        "DL310",
+                        f"tag {tag!r} is bound to constant {detail} which "
+                        f"async_ea.py no longer defines", where=where))
+                elif (str(val).lower() != tag.lower() if kind == "const_ci"
+                      else val != tag):
+                    findings.append(Finding(
+                        "DL310",
+                        f"tag {tag!r} is bound to {detail} but the code's "
+                        f"value is {val!r} — schedule and wire protocol "
+                        f"disagree", where=where))
+
+    # -- 2. bound constants are actually used by the code -------------------
+    for const in sorted(used_consts):
+        if const in facts.consts and facts.loads.get(const, 0) < 1:
+            findings.append(Finding(
+                "DL310",
+                f"wire constant {const} is defined but never used — the "
+                f"schedules model a message the code no longer sends",
+                where=f"async_ea.{const}"))
+
+    # -- 3. transcribed call sites exist ------------------------------------
+    for func, const, why in _CALLSITE_EVIDENCE:
+        sends = facts.sends.get(func)
+        if sends is None:
+            findings.append(Finding(
+                "DL310",
+                f"function {func}() (transcribed by the schedules: {why}) "
+                f"no longer exists in async_ea.py", where=f"async_ea.{func}"))
+        elif ("const", const) not in sends:
+            findings.append(Finding(
+                "DL310",
+                f"{func}() no longer sends {const} — schedules still "
+                f"transcribe it ({why})", where=f"async_ea.{func}"))
+    if not any("keys" == k and "stale" in keys
+               for sends in facts.sends.values()
+               for k, keys in sends):
+        findings.append(Finding(
+            "DL310",
+            "no send_msg call carries the 'stale' reply key — the "
+            "stale-epoch refusal the zombie-fence schedule models is gone "
+            "from the code (_refuse_stale)", where="async_ea._refuse_stale"))
+
+    # -- 4. question order: Center? before delta? ---------------------------
+    client_sends = [c for k, c in facts.sends.get("sync_client", ())
+                    if k == "const"]
+    code_order_ok = ("CENTER_Q" in client_sends and "DELTA_Q" in client_sends
+                     and (client_sends.index("CENTER_Q")
+                          < client_sends.index("DELTA_Q")))
+    if not code_order_ok:
+        findings.append(Finding(
+            "DL310",
+            "sync_client() no longer sends CENTER_Q before DELTA_Q — the "
+            "fetch-then-push round order every schedule transcribes",
+            where="async_ea.sync_client"))
+    for sname, sched in schedules.items():
+        for rank, ops in sched.items():
+            tags = [op.tag for op in ops if op.kind == "send"]
+            if "Center?" in tags and "delta?" in tags:
+                if tags.index("Center?") > tags.index("delta?"):
+                    findings.append(Finding(
+                        "DL310",
+                        f"rank sends delta? before Center? but "
+                        f"sync_client() fetches the center first — the "
+                        f"schedule models a question order the code "
+                        f"never executes", where=f"{sname}:{rank}"))
+
+    # -- 5. coverage: every *_Q message type is modeled or exempted ---------
+    modeled = {d for t, (k, d) in TAG_BINDINGS.items()
+               if k in ("const", "const_ci")}
+    for name in sorted(facts.consts):
+        if name.endswith("_Q") and name not in modeled \
+                and name not in KNOWN_UNMODELED:
+            findings.append(Finding(
+                "DL310",
+                f"message-type constant {name} has no schedule modeling "
+                f"it and no KNOWN_UNMODELED exemption — new wire traffic "
+                f"must be modeled or consciously exempted",
+                where=f"async_ea.{name}"))
+    return findings

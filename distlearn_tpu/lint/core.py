@@ -41,6 +41,20 @@ RULES = {
               "error"),
     "DL103": ("blocking network/queue call while holding a lock", "error"),
     "DL104": ("peers disagree on message order (protocol desync)", "error"),
+    "DL111": ("field written with no common lock against another thread's "
+              "access (lockset race)", "error"),
+    "DL112": ("lock-guarded field read without the guard elsewhere "
+              "(torn-read hazard)", "warning"),
+    "DL301": ("protocol model reaches a state with no enabled action "
+              "before completion (deadlock)", "error"),
+    "DL302": ("a stale-epoch center applies a delta in some interleaving "
+              "(epoch fence violated)", "error"),
+    "DL303": ("a (client, seq) delta is applied more than once across "
+              "failover (exactly-once violated)", "error"),
+    "DL304": ("serve slot/page accounting diverges between scheduler and "
+              "engine (resource leak)", "error"),
+    "DL310": ("hand-written protocol schedule drifted from the code it "
+              "models (conformance)", "error"),
 }
 
 
@@ -91,10 +105,16 @@ def format_findings(findings: Sequence[Finding], *, header: str = "") -> str:
 
 @dataclass
 class LintResult:
-    """Findings for one lintable unit (a step function or a protocol)."""
+    """Findings for one lintable unit (a step function or a protocol).
+
+    ``info`` carries analysis metadata that is not a finding — the model
+    checker reports its explored state/transition counts here so the CLI
+    can print ``OK (1,234 states)`` and the JSON output stays auditable.
+    """
 
     name: str
     findings: list[Finding] = field(default_factory=list)
+    info: dict = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:

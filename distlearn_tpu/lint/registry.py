@@ -41,6 +41,8 @@ class Unit:
     args: tuple = ()
     mesh: Any = None
     in_specs: Any = None     # pytree of PartitionSpecs matching args (DL202)
+    info: dict = field(default_factory=dict)  # analysis metadata
+    # (state counts, ...) surfaced on the LintResult / in --format json
 
 
 @dataclass(frozen=True)
@@ -318,6 +320,27 @@ def _protocol_family():
     return units
 
 
+def _model_family():
+    """Explicit-state model checking (DL301-DL304) + schedule↔code
+    conformance (DL310): every process model in ``lint/model.py`` is
+    exhaustively explored, with its state/transition counts carried as
+    unit info, and every ``async_ea_*`` schedule is diffed against the
+    wire constants/call sites in ``async_ea.py``."""
+    from distlearn_tpu.lint.conformance import lint_conformance
+    from distlearn_tpu.lint.model import lint_models
+    units = [Unit(spec.name, rep.findings, info=rep.info)
+             for rep, spec in lint_models()]
+    units.append(Unit("conformance", lint_conformance()))
+    return units
+
+
+def _races_family():
+    """Static lockset race detection (DL111/DL112) over the threaded
+    modules (async_ea, ha, serve, obs)."""
+    from distlearn_tpu.lint.races import lint_races
+    return [Unit("lockset", lint_races())]
+
+
 _FAMILIES = {
     "sgd": Entry("sgd", "fused AllReduceSGD steps (sgd/scan/sync/eval)",
                  _sgd_family),
@@ -340,6 +363,13 @@ _FAMILIES = {
     "protocol": Entry("protocol",
                       "host comm schedules (tree/ring/AsyncEA) + lock audit",
                       _protocol_family),
+    "model": Entry("model",
+                   "explicit-state protocol models (sync/sharded/replay/"
+                   "failover/serve) + schedule↔code conformance",
+                   _model_family),
+    "races": Entry("races",
+                   "static lockset race detection over the threaded modules",
+                   _races_family),
 }
 
 
@@ -382,7 +412,8 @@ def run_family_costed(name: str, *, suppress: Sequence[str] = (),
             reports[u.name] = report
             findings += cost_findings
         results.append(LintResult(f"{name}:{u.name}",
-                                  filter_suppressed(findings, suppress)))
+                                  filter_suppressed(findings, suppress),
+                                  info=dict(u.info)))
     if cost:
         from distlearn_tpu.lint import budget as budget_mod
         bfindings = filter_suppressed(

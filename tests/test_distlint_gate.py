@@ -24,17 +24,45 @@ def test_family_lints_clean(family, devices):
     assert all(r.ok for r in results), f"distlint findings:\n{report}"
 
 
+def _tool_or_skip(tool: str, require_var: str):
+    """Resolve an external lint tool.  A tool-less environment skips —
+    unless ``require_var`` is set (CI installs ``.[lint]`` and sets it),
+    in which case a missing binary is a hard gate failure instead of a
+    silent pass."""
+    import os
+    import shutil
+    path = shutil.which(tool)
+    if path is None:
+        if os.environ.get(require_var):
+            pytest.fail(f"{require_var} is set but no {tool!r} binary is "
+                        f"on PATH — install the 'lint' extra "
+                        f"(pip install .[lint])")
+        pytest.skip(f"{tool} not installed in this environment")
+    return path
+
+
 def test_ruff_clean_repo_wide():
     """Enforce the [tool.ruff] config over the whole repo (the PR-1 config
     only gated the lint package); skipped where the container has no ruff
-    binary."""
+    binary, FAILED if DISTLEARN_REQUIRE_RUFF=1 promises one."""
     import os
-    import shutil
     import subprocess
-    if shutil.which("ruff") is None:
-        pytest.skip("ruff not installed in this environment")
+    ruff = _tool_or_skip("ruff", "DISTLEARN_REQUIRE_RUFF")
     root = os.path.join(os.path.dirname(__file__), "..")
-    proc = subprocess.run(["ruff", "check", "."],
+    proc = subprocess.run([ruff, "check", "."],
+                          cwd=root, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_mypy_clean():
+    """Typecheck distlearn_tpu/lint + distlearn_tpu/serve with the
+    committed [tool.mypy] config; skip-if-absent like ruff, enforced
+    under DISTLEARN_REQUIRE_MYPY=1."""
+    import os
+    import subprocess
+    mypy = _tool_or_skip("mypy", "DISTLEARN_REQUIRE_MYPY")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    proc = subprocess.run([mypy, "--config-file", "pyproject.toml"],
                           cwd=root, capture_output=True, text=True)
     assert proc.returncode == 0, proc.stdout + proc.stderr
 

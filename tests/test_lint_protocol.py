@@ -8,7 +8,7 @@ import pytest
 from distlearn_tpu.lint.protocol import (async_ea_sync_schedule,
                                          check_schedules,
                                          lint_comm_protocols,
-                                         lock_order_audit, recv,
+                                         lock_order_audit, recv, recv_any,
                                          ring_allreduce_schedule, send,
                                          tree_allreduce_schedule)
 
@@ -85,6 +85,47 @@ def test_dl104_tag_skew_detected_point_to_point():
              1: [recv(0, "tensor"), recv(0, "hdr")]}
     fs = check_schedules(sched, name="skew")
     assert _rules(fs) == ["DL104"]
+
+
+def test_dl104_recv_any_tag_mismatch_buffered():
+    """recv_any still checks the DELIVERED tag: accepting any sender is
+    not accepting any message."""
+    sched = {0: [send(1, "hdr")], 1: [recv_any("payload")]}
+    fs = check_schedules(sched, name="any-skew")
+    assert _rules(fs) == ["DL104"]
+    assert "disagree on message order" in fs[0].message
+
+
+def test_dl104_recv_any_tag_mismatch_rendezvous():
+    """Same desync through the rendezvous delivery path (the send fires
+    directly into the posted recv_any, no channel queue involved)."""
+    sched = {0: [send(1, "hdr")], 1: [recv_any("payload")]}
+    fs = check_schedules(sched, buffered_sends=False, name="any-skew-rdv")
+    assert _rules(fs) == ["DL104"]
+
+
+def test_dl104_tag_skew_under_rendezvous():
+    sched = {0: [send(1, "a"), recv(1, "b")],
+             1: [recv(0, "x"), send(0, "b")]}
+    fs = check_schedules(sched, buffered_sends=False, name="skew-rdv")
+    assert _rules(fs) == ["DL104"]
+
+
+def test_recv_any_admits_either_sender_both_modes():
+    sched = {0: [send(2, "hello")], 1: [send(2, "hello")],
+             2: [recv_any("hello"), recv_any("hello")]}
+    assert check_schedules(sched) == []
+    assert check_schedules(sched, buffered_sends=False) == []
+
+
+def test_async_ea_handshake_clean_under_rendezvous():
+    """The AsyncEA handshake is strictly alternating (ask, answer), so
+    unlike the ring it needs no sender thread: every send meets a posted
+    recv even under rendezvous semantics, in both wire framings."""
+    assert check_schedules(async_ea_sync_schedule(),
+                           buffered_sends=False) == []
+    assert check_schedules(async_ea_sync_schedule(packed=True),
+                           buffered_sends=False) == []
 
 
 # --------------------------------------------------------- DL102 / DL103

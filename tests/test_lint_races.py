@@ -1,0 +1,236 @@
+"""Static lockset race detection (DL111/DL112): the repo's threaded
+modules audit clean; stripping a real lock from the real source fires
+DL111; synthetic classes pin the verdict semantics (write-write race,
+torn read, init-write exclusion, single-thread silence)."""
+
+import ast
+import inspect
+
+import pytest
+
+from distlearn_tpu.lint.races import (BENIGN_FIELDS, analyze_source,
+                                      lint_races)
+
+pytestmark = pytest.mark.model
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------- real tree
+
+def test_repo_threaded_modules_audit_clean():
+    assert lint_races() == []
+
+
+def test_benign_list_entries_all_suppress_something():
+    """Every allowlist entry must still be load-bearing: removing it has
+    to produce a finding, otherwise the entry is stale documentation."""
+    import distlearn_tpu.lint.races as races_mod
+    saved = dict(BENIGN_FIELDS)
+    try:
+        BENIGN_FIELDS.clear()
+        raw = {(f.where.rsplit(".", 2)[-2], f.where.rsplit(".", 2)[-1])
+               for f in lint_races()}
+    finally:
+        BENIGN_FIELDS.update(saved)
+    assert raw == set(saved), (
+        f"stale benign entries: {sorted(set(saved) - raw)}; "
+        f"unsuppressed findings: {sorted(raw - set(saved))}")
+    assert races_mod.lint_races() == []
+
+
+# -------------------------------------------------- seeded lock stripping
+
+def test_dl111_stripping_count_sync_lock_fires():
+    """The acceptance-criteria mutation: remove ``with self._lock:`` from
+    ``_count_sync`` in the REAL async_ea source — the sync counter write
+    loses its guard against the lock-holding readers and DL111 names the
+    field with evidence."""
+    from distlearn_tpu.parallel import async_ea
+
+    class Strip(ast.NodeTransformer):
+        def visit_FunctionDef(self, node):
+            self.generic_visit(node)
+            if node.name == "_count_sync":
+                body = []
+                for st in node.body:
+                    if isinstance(st, ast.With):
+                        body.extend(st.body)
+                    else:
+                        body.append(st)
+                node.body = body
+            return node
+
+    src = inspect.getsource(async_ea)
+    mutated = ast.unparse(Strip().visit(ast.parse(src)))
+    assert mutated != src
+    fs = analyze_source(mutated, "mutated")
+    assert "DL111" in _rules(fs)
+    hit = [f for f in fs if "_sync_count" in f.where]
+    assert hit, [str(f) for f in fs]
+    assert "holds no lock" in hit[0].message
+
+
+# ----------------------------------------------------- verdict semantics
+
+_RACY = """
+import threading
+class W:
+    def __init__(self):
+        self._n = 0                     # init write: excluded
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        self._n += 1                    # unguarded write
+    def read(self):
+        return self._n                  # cross-thread read
+"""
+
+_TORN = """
+import threading
+class W:
+    def __init__(self):
+        self._n = 0
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        with self._lock:
+            self._n += 1                # guarded write...
+    def read(self):
+        return self._n                  # ...lock-free read elsewhere
+"""
+
+_CLEAN = """
+import threading
+class W:
+    def __init__(self):
+        self._n = 0
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        with self._lock:
+            self._n += 1
+    def read(self):
+        with self._lock:
+            return self._n
+"""
+
+_SINGLE = """
+class W:
+    def step(self):
+        self._n += 1                    # no second thread entry: quiet
+    def read(self):
+        return self._n
+"""
+
+
+def _with_api(src, api):
+    """Run analyze_source with a temporary THREAD_API entry for W."""
+    from distlearn_tpu.lint.races import THREAD_API
+    THREAD_API["W"] = api
+    try:
+        return analyze_source(src, "synthetic")
+    finally:
+        del THREAD_API["W"]
+
+
+def test_dl111_unguarded_cross_thread_write():
+    fs = _with_api(_RACY, {"read"})
+    assert _rules(fs) == ["DL111"]
+    assert fs[0].severity == "error" and "_n" in fs[0].where
+
+
+def test_dl112_guarded_write_unguarded_read_is_warning():
+    fs = _with_api(_TORN, {"read"})
+    assert _rules(fs) == ["DL112"]
+    assert fs[0].severity == "warning"
+    assert "torn-read" in fs[0].message
+
+
+def test_consistent_locking_is_clean():
+    assert _with_api(_CLEAN, {"read"}) == []
+
+
+def test_init_writes_do_not_count_as_races():
+    # _RACY minus the _loop write: only __init__ writes _n -> clean
+    src = _RACY.replace("self._n += 1                    # unguarded write",
+                        "pass")
+    assert _with_api(src, {"read"}) == []
+
+
+def test_single_threaded_class_is_quiet():
+    assert analyze_source(_SINGLE, "synthetic") == []
+
+
+def test_nested_closures_drop_lexical_locks():
+    """A closure handed to a thread does NOT hold the lock its spawn
+    site held (the _fanout leg pattern) — writes inside it race with the
+    guarded readers."""
+    src = """
+class W:
+    def spawn(self):
+        with self._lock:
+            def leg():
+                self._n += 1            # lock NOT held when leg runs
+            return leg
+    def read(self):
+        with self._lock:
+            return self._n
+"""
+    fs = _with_api(src, {"read", "spawn"})
+    assert _rules(fs) == ["DL111"]
+
+
+def test_call_graph_propagates_held_locks():
+    """A write in a helper only reached under the lock is guarded."""
+    src = """
+import threading
+class W:
+    def __init__(self):
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        with self._lock:
+            self._bump()
+    def _bump(self):
+        self._n += 1                    # guarded via the caller
+    def read(self):
+        with self._lock:
+            return self._n
+"""
+    assert _with_api(src, {"read"}) == []
+
+
+def test_try_finally_release_counts_as_held():
+    src = """
+import threading
+class W:
+    def __init__(self):
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            self._n += 1
+        finally:
+            self._lock.release()
+    def read(self):
+        with self._lock:
+            return self._n
+"""
+    assert _with_api(src, {"read"}) == []
+
+
+def test_container_mutators_count_as_writes():
+    src = """
+import threading
+class W:
+    def __init__(self):
+        self._t = threading.Thread(target=self._loop)
+    def _loop(self):
+        self._items.append(1)           # unguarded container mutation
+    def read(self):
+        with self._lock:
+            return len(self._items)
+"""
+    fs = _with_api(src, {"read"})
+    assert _rules(fs) == ["DL111"]
+    assert "_items" in fs[0].where

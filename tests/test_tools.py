@@ -143,6 +143,30 @@ def test_distlint_json_format_and_update_budgets(tmp_path, capsys):
     assert table["peak_bytes"] is None or table["peak_bytes"] > 0
 
 
+def test_distlint_model_and_races_flags(capsys):
+    """--model/--races shorthands: exit 0 on the clean tree, text output
+    carries the exhaustive state counts, and the JSON schema is stable
+    (findings/costs/info/units/errors with per-model state counts)."""
+    import json as _json
+    cli = _distlint_cli()
+
+    assert cli.main(["--model", "--races"]) == 0
+    out = capsys.readouterr().out
+    assert "model:sync: OK (" in out and "states)" in out
+    assert "races:lockset: OK" in out
+    assert "model:conformance: OK" in out
+
+    assert cli.main(["--model", "--races", "--format", "json"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert set(doc) == {"findings", "costs", "info", "units", "errors"}
+    assert doc["findings"] == [] and doc["errors"] == 0
+    assert doc["units"] == 7
+    for unit in ("model:sync", "model:sharded", "model:replay",
+                 "model:failover", "model:serve"):
+        assert doc["info"][unit]["states"] > 0
+        assert doc["info"][unit]["transitions"] > 0
+
+
 def test_ea_convergence_tool_runs():
     """Smoke the EASGD-vs-SGD convergence harness end-to-end (tiny budget,
     2 ranks, throttled links): both algorithms complete, curves land on

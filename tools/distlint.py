@@ -7,6 +7,8 @@
     python tools/distlint.py --list             # what's registered
     python tools/distlint.py --all --disable DL004
     python tools/distlint.py --all --format json
+    python tools/distlint.py --model            # protocol model checking
+    python tools/distlint.py --races            # lockset race detection
     python tools/distlint.py --update-budgets   # re-baseline cost lockfiles
 
 Exit code 0 when no error-severity findings survive suppression, 1 when
@@ -75,6 +77,13 @@ def main(argv=None) -> int:
                     metavar="NAME", help="lint one family (repeatable)")
     ap.add_argument("--list", action="store_true",
                     help="list registered families and rules, then exit")
+    ap.add_argument("--model", action="store_true",
+                    help="run the explicit-state protocol models + "
+                         "schedule conformance (shorthand for "
+                         "--family model)")
+    ap.add_argument("--races", action="store_true",
+                    help="run the static lockset race detector "
+                         "(shorthand for --family races)")
     ap.add_argument("--disable", action="append", default=[],
                     metavar="RULE", help="suppress a rule id (repeatable)")
     ap.add_argument("--format", choices=("text", "json"), default="text",
@@ -101,6 +110,10 @@ def main(argv=None) -> int:
             print(f"  {rid}  [{sev}] {title}")
         return 0
 
+    if args.model:
+        args.family.append("model")
+    if args.races:
+        args.family.append("races")
     wanted = list(fams) if (args.all or (args.update_budgets
                                          and not args.family)) \
         else args.family
@@ -148,6 +161,7 @@ def main(argv=None) -> int:
                 for r in results for f in r.findings],
             "costs": {fam: _cost_table(reports)
                       for fam, reports in all_reports.items()},
+            "info": {r.name: r.info for r in results if r.info},
             "units": len(results),
             "errors": bad,
         }
@@ -158,7 +172,9 @@ def main(argv=None) -> int:
         if res.findings:
             print(format_findings(res.findings, header=f"{res.name}:"))
         elif not args.quiet:
-            print(f"{res.name}: OK")
+            extra = (f" ({res.info['states']:,} states)"
+                     if "states" in res.info else "")
+            print(f"{res.name}: OK{extra}")
     if args.costs:
         print("costs (bytes/step per device, post-fusion):")
         for fam, reports in all_reports.items():
