@@ -1112,6 +1112,147 @@ def host_wire_bench(iters: int = 20, reps: int = 3):
     return out
 
 
+def wire_cpu_bench(reps: int = 9, sync_rounds: int = 30):
+    """Fused wire-codec CPU cost (the zero-copy wire gate): ns/byte of
+    the int8 encode (quantize + error-feedback residual) and apply
+    (dequantize + elastic add) stripe paths — the reference numpy
+    pipeline (``encode_leaves`` then a decoded() f32 copy then
+    ``subtract``; ``decode_into`` scratch then ``add``) against the
+    fused blocked kernels (ops/wire_kernels: one cache-sized chunk pass,
+    no decoded f32 round-trip) — plus an UNTHROTTLED int8 EASGD
+    echo-sync loop's whole-process CPU time (``time.process_time``,
+    both ends in-process) with the fused path off/on via
+    ``DISTLEARN_TPU_WIREK`` resolved at construction.
+
+    Best of ``reps`` trials on the CIFAR-shaped leaf list (same
+    convention as host_wire_bench: this shared 1-core host's noise is
+    strictly additive, so min is the least-contaminated estimate of the
+    intrinsic codec cost — a median still wobbles ~10% run to run).
+    Chip-free and jax-import-free (the fused CPU route is the compiled
+    SIMD kernel or blocked numpy, not XLA — see docs/PERF.md)."""
+    import threading
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.comm import wire
+    from distlearn_tpu.ops import wire_kernels
+
+    shapes = _WIRE_PARAM_SETS["cifar_convnet"]
+    rs = np.random.RandomState(0)
+    deltas = [rs.randn(*s).astype(np.float32) * 0.01 for s in shapes]
+    logical = sum(a.nbytes for a in deltas)
+
+    def best_ns_per_byte(fn):
+        best = float("inf")
+        fn()                                   # warmup (allocs, caches)
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            fn()
+            best = min(best, _t.perf_counter() - t0)
+        return best / logical * 1e9
+
+    # -- encode: reference = the pre-fusion _encode_stripe body ----------
+    res = [np.zeros_like(a) for a in deltas]
+
+    def enc_ref():
+        p = wire.encode_leaves(deltas, "int8")
+        for d, r, dec in zip(deltas, res, p.decoded()):
+            np.subtract(d, dec, out=r)
+
+    fb = wire.FrameBuffer()
+
+    def enc_fused():
+        wire_kernels.encode_ef_into(deltas, res, "int8", out=fb)
+
+    # -- apply: reference = recv-decode into f32 scratch, then += --------
+    pay = wire.encode_leaves(deltas, "int8")
+    entries = pay.manifest["leaves"]
+    center = [np.zeros(s, np.float32) for s in shapes]
+    scratch = [np.empty(s, np.float32) for s in shapes]
+
+    def apply_ref():
+        for t, e, b, sc in zip(center, entries, pay.bufs, scratch):
+            wire.decode_into(e, b, sc)
+            np.add(t, sc, out=t)
+
+    def apply_fused():
+        for t, e, b in zip(center, entries, pay.bufs):
+            wire_kernels.dequant_add(t, b, e["scale"], out=t)
+
+    from distlearn_tpu.ops import wire_native
+    row: dict = {
+        "leaves": len(deltas), "logical_mb": logical / 1e6,
+        "reps": reps,
+        # which fused tier measured: the compiled SIMD kernel or the
+        # blocked-numpy fallback (no compiler on the host)
+        "native_backend": wire_native.available(),
+        "int8_encode_ref_ns_per_byte": best_ns_per_byte(enc_ref),
+        "int8_encode_fused_ns_per_byte": best_ns_per_byte(enc_fused),
+        "int8_apply_ref_ns_per_byte": best_ns_per_byte(apply_ref),
+        "int8_apply_fused_ns_per_byte": best_ns_per_byte(apply_fused),
+    }
+    row["int8_encode_speedup"] = (row["int8_encode_ref_ns_per_byte"]
+                                  / row["int8_encode_fused_ns_per_byte"])
+    row["int8_apply_speedup"] = (row["int8_apply_ref_ns_per_byte"]
+                                 / row["int8_apply_fused_ns_per_byte"])
+
+    # -- end-to-end: unthrottled int8 sync loop, fused path off vs on ----
+    from distlearn_tpu.parallel.async_ea import AsyncEAClient, AsyncEAServer
+    from distlearn_tpu.utils.logging import set_verbose
+    set_verbose(False)
+
+    params = {f"p{i}": rs.randn(*s).astype(np.float32)
+              for i, s in enumerate(shapes)}
+
+    def sync_loop_cpu(wirek: str) -> float:
+        old = os.environ.get("DISTLEARN_TPU_WIREK")
+        os.environ["DISTLEARN_TPU_WIREK"] = wirek
+        try:
+            port = _reserve_port_window(3)
+            errs: list = []
+
+            def server():
+                try:
+                    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1,
+                                        accept_timeout=60.0)
+                    srv.init_server({k: v.copy()
+                                     for k, v in params.items()})
+                    p = dict(params)
+                    for _ in range(sync_rounds):
+                        p = srv.sync_server(p)
+                    srv.close()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            th = threading.Thread(target=server, daemon=True)
+            th.start()
+            cl = AsyncEAClient("127.0.0.1", port, node=1, tau=1,
+                               alpha=0.5, codec="int8")
+            p = cl.init_client({k: v.copy() for k, v in params.items()})
+            c0 = _t.process_time()
+            for _ in range(sync_rounds):
+                p, _ = cl.sync_client(p)
+            cpu = _t.process_time() - c0
+            cl.close()
+            th.join(timeout=120)
+            if errs:
+                raise errs[0]
+            return cpu
+        finally:
+            if old is None:
+                os.environ.pop("DISTLEARN_TPU_WIREK", None)
+            else:
+                os.environ["DISTLEARN_TPU_WIREK"] = old
+
+    row["sync_rounds"] = sync_rounds
+    row["sync_loop_cpu_s_numpy"] = sync_loop_cpu("0")
+    row["sync_loop_cpu_s_fused"] = sync_loop_cpu("1")
+    row["sync_loop_cpu_reduction"] = (row["sync_loop_cpu_s_numpy"]
+                                      / row["sync_loop_cpu_s_fused"])
+    return row
+
+
 def async_ea_bench(param_mb: int = 8, n_clients: int = 2,
                    syncs_per_client: int = 10,
                    server_impl: str = "serial"):
@@ -2173,6 +2314,20 @@ def main():
                       file=sys.stderr)
         except Exception as e:  # noqa: BLE001
             print(f"[bench] host wire bench failed: {e}", file=sys.stderr)
+        try:
+            details["wire_cpu_cost"] = wire_cpu_bench()
+            w = details["wire_cpu_cost"]
+            print(f"[bench] wire cpu ({w['logical_mb']:.1f}MB int8): "
+                  f"encode {w['int8_encode_ref_ns_per_byte']:.2f} -> "
+                  f"{w['int8_encode_fused_ns_per_byte']:.2f} ns/B "
+                  f"({w['int8_encode_speedup']:.2f}x fused); apply "
+                  f"{w['int8_apply_ref_ns_per_byte']:.2f} -> "
+                  f"{w['int8_apply_fused_ns_per_byte']:.2f} ns/B "
+                  f"({w['int8_apply_speedup']:.2f}x); sync-loop CPU "
+                  f"{w['sync_loop_cpu_reduction']:.2f}x lower",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] wire cpu bench failed: {e}", file=sys.stderr)
 
     # --- AsyncEA parameter-server protocol throughput ------------------------
     if os.environ.get("BENCH_SKIP_ASYNC") != "1":
@@ -2468,6 +2623,26 @@ if __name__ == "__main__":
         with open(path, "w") as fh:
             json.dump(details, fh, indent=2)
         print(json.dumps(sv["rows"]))
+    elif "--wire-cpu-probe" in sys.argv:
+        # Standalone fused-codec probe: runs wire_cpu_bench alone and
+        # MERGES the row into BENCH_DETAILS.json (read-modify-write) so
+        # a codec re-measure doesn't discard the training rows.  Chip-
+        # and jax-free; also the distlint wirek budget refresh source.
+        _pin_cpu(1)
+        w = wire_cpu_bench(
+            int(os.environ.get("BENCH_WIRE_CPU_REPS", "9")),
+            int(os.environ.get("BENCH_WIRE_CPU_SYNCS", "30")))
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_DETAILS.json")
+        try:
+            with open(path) as fh:
+                details = json.load(fh)
+        except (OSError, ValueError):
+            details = {}
+        details["wire_cpu_cost"] = w
+        with open(path, "w") as fh:
+            json.dump(details, fh, indent=2)
+        print(json.dumps(w))
     elif "--multichip-probe" in sys.argv:
         _pin_cpu(int(os.environ.get("BENCH_MC_DEVICES", "8")))
         _enable_compile_cache()

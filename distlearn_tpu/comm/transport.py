@@ -101,6 +101,14 @@ def _wire_pack_secs():
                          "(manifest build + quantization)")
 
 
+def _wire_zero_copy():
+    return obs.counter("wire_zero_copy_total",
+                       "packed-frame sends by staging outcome: hit = one "
+                       "contiguous frame-buffer iovec (fused kernels wrote "
+                       "wire bytes in place), miss = per-leaf gather",
+                       labels=("result",))
+
+
 class Conn:
     """A framed connection over one TCP socket.
 
@@ -538,12 +546,18 @@ class Conn:
         total = len(meta) + payload.wire_nbytes
         t0 = time.perf_counter()
         try:
-            # one vectored send: frame header + manifest + every leaf
-            # buffer (raw leaves are zero-copy views of the caller's
-            # arrays; no staging copy of the data region is ever built)
-            self._sendv([_HDR.pack(ord("P"), total), meta]
-                        + [memoryview(b).cast("B")
-                           for b in payload.bufs if b.nbytes])
+            if payload.frame is not None:
+                # frame-buffer staging (wire.FrameBuffer): the fused
+                # codec kernels already wrote every wire byte into ONE
+                # contiguous region — ship it as a single iovec
+                data = [memoryview(payload.frame).cast("B")]
+            else:
+                # one vectored send: frame header + manifest + every leaf
+                # buffer (raw leaves are zero-copy views of the caller's
+                # arrays; no staging copy of the data region is built)
+                data = [memoryview(b).cast("B")
+                        for b in payload.bufs if b.nbytes]
+            self._sendv([_HDR.pack(ord("P"), total), meta] + data)
         except (BlockingIOError, InterruptedError) as e:
             _timeouts().labels(op="send").inc()
             raise TimeoutError("send timed out (socket timeout)") from e
@@ -557,6 +571,8 @@ class Conn:
                 payload.logical_nbytes)
             _wire_ratio().labels(codec=payload.codec).set(
                 payload.logical_nbytes / nbytes if nbytes else 0.0)
+            _wire_zero_copy().labels(
+                result="hit" if payload.frame is not None else "miss").inc()
         self._pace(nbytes, t0)
 
     def recv_tensors(self, out: list | None = None, n: int | None = None,
@@ -590,8 +606,43 @@ class Conn:
                 f"expected tensor list, got kind {chr(kind)!r}")
         return self._recv_packed_body(length, out, want, deadline, t0)
 
+    def recv_payload(self, n: int, deadline: float | None = None
+                     ) -> "wire.PackedPayload":
+        """Receive a tensor list WITHOUT decoding — wire-dtype buffers plus
+        the manifest, as a :class:`wire.PackedPayload`.  The fused-apply
+        path (``ops/wire_kernels.dequant_add``) consumes quantized bytes
+        directly, so decoding here would materialize the f32 copy the
+        fused kernels exist to avoid.  Legacy per-leaf ``'T'`` frames are
+        wrapped as a raw payload, so callers need no separate path."""
+        want = int(n)
+        if want == 0:
+            return wire.PackedPayload(
+                {"v": wire.WIRE_V, "codec": "raw", "leaves": []},
+                [], "raw", 0, 0)
+        t0 = time.perf_counter() if self._obs else 0.0
+        kind, length = self._recv_frame_header(deadline)
+        if kind == ord("T"):
+            arrs = [self._recv_tensor_body(length, None, deadline, t0)]
+            for _ in range(1, want):
+                arrs.append(self.recv_tensor(deadline=deadline))
+            entries, offset = [], 0
+            for a in arrs:
+                entries.append({"dtype": a.dtype.name,
+                                "shape": list(a.shape), "enc": "raw",
+                                "offset": offset, "nbytes": a.nbytes})
+                offset += a.nbytes
+            return wire.PackedPayload(
+                {"v": wire.WIRE_V, "codec": "raw", "leaves": entries},
+                arrs, "raw", offset, offset)
+        if kind != ord("P"):
+            raise ProtocolError(
+                f"expected tensor list, got kind {chr(kind)!r}")
+        return self._recv_packed_body(length, None, want, deadline, t0,
+                                      decode=False)
+
     def _recv_packed_body(self, length: int, out: list | None, want: int,
-                          deadline: float | None, t0: float) -> list:
+                          deadline: float | None, t0: float,
+                          decode: bool = True):
         if length < _THDR.size:
             self._recv_exact(length, mid_frame=True, deadline=deadline)
             raise ProtocolError(f"packed frame too short: {length} bytes")
@@ -612,10 +663,29 @@ class Conn:
             raise ProtocolError(msg)
 
         try:
-            _, entries = wire.parse_manifest(raw, data_nbytes,
-                                             expect_n=want)
+            codec, entries = wire.parse_manifest(raw, data_nbytes,
+                                                 expect_n=want)
         except ValueError as e:
             _drain_and_fail(str(e))
+        if not decode:
+            # read each leaf's WIRE bytes verbatim (no dequantization) —
+            # the caller applies straight from the quantized buffers
+            bufs, logical = [], 0
+            for entry in entries:
+                wbuf = np.empty(tuple(entry["shape"]),
+                                wire.wire_dtype(entry))
+                if entry["nbytes"]:
+                    self._recv_exact(entry["nbytes"],
+                                     memoryview(wbuf).cast("B"),
+                                     mid_frame=True, deadline=deadline)
+                bufs.append(wbuf)
+                logical += (math.prod(entry["shape"])
+                            * np.dtype(entry["dtype"]).itemsize)
+            if self._obs:
+                self._h_tensor.observe(time.perf_counter() - t0)
+            return wire.PackedPayload(
+                {"v": wire.WIRE_V, "codec": codec, "leaves": entries},
+                bufs, codec, data_nbytes, logical)
         if out is not None:
             for i, (entry, o) in enumerate(zip(entries, out)):
                 if (o.dtype != np.dtype(entry["dtype"])

@@ -47,22 +47,30 @@ class PackedPayload:
 
     ``bufs[i]`` is the wire-format array for ``manifest["leaves"][i]`` —
     the original array itself for raw leaves (zero copy), a fresh
-    fp16/int8 array for encoded ones.
+    fp16/int8 array for encoded ones.  ``frame`` is non-None when every
+    wire byte lives in ONE contiguous staging region (a
+    :class:`FrameBuffer`): the transport then ships a single iovec
+    instead of a per-leaf gather.
     """
 
-    __slots__ = ("manifest", "bufs", "codec", "wire_nbytes", "logical_nbytes")
+    __slots__ = ("manifest", "bufs", "codec", "wire_nbytes",
+                 "logical_nbytes", "frame")
 
     def __init__(self, manifest: dict, bufs: list, codec: str,
-                 wire_nbytes: int, logical_nbytes: int):
+                 wire_nbytes: int, logical_nbytes: int,
+                 frame: np.ndarray | None = None):
         self.manifest = manifest
         self.bufs = bufs
         self.codec = codec
         self.wire_nbytes = wire_nbytes
         self.logical_nbytes = logical_nbytes
+        self.frame = frame
 
     def decoded(self) -> list[np.ndarray]:
         """What the receiver will reconstruct — the error-feedback residual
-        is ``sent_value - decoded()`` (raw leaves decode to themselves)."""
+        is ``sent_value - decoded()`` (raw leaves decode to themselves).
+        Allocates fresh arrays per call; steady-state paths use
+        :meth:`decoded_into`."""
         out = []
         for entry, buf in zip(self.manifest["leaves"], self.bufs):
             if entry["enc"] == "raw":
@@ -73,6 +81,64 @@ class PackedPayload:
                 decode_into(entry, buf, dec)
                 out.append(dec)
         return out
+
+    def decoded_into(self, out: list[np.ndarray]) -> list[np.ndarray]:
+        """:meth:`decoded` into preallocated logical-dtype buffers — the
+        residual/apply hot paths reuse one scratch list across syncs so a
+        steady-state sync allocates nothing.  Raw leaves are returned as
+        the zero-copy wire buffer itself (``out[i]`` untouched) unless
+        they alias it already."""
+        res = []
+        for entry, buf, o in zip(self.manifest["leaves"], self.bufs, out):
+            if entry["enc"] == "raw":
+                res.append(buf)
+            else:
+                decode_into(entry, buf, o)
+                res.append(o)
+        return res
+
+
+class FrameBuffer:
+    """Reusable contiguous staging for one packed frame's data region.
+
+    One per stripe, grown to the stripe's wire size on first use and
+    reused for every later sync (stripe wire sizes are fixed by the leaf
+    schedule, so steady state never reallocates).  Fused codec kernels
+    write their wire bytes straight into :meth:`view` windows; the
+    transport ships :meth:`frame` as a single iovec — no per-leaf gather,
+    no per-sync allocation."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self, nbytes: int = 0):
+        self.buf = np.empty(int(nbytes), np.uint8)
+
+    def reserve(self, nbytes: int) -> None:
+        """Grow (never shrink) the staging region to ``nbytes``."""
+        if self.buf.nbytes < nbytes:
+            self.buf = np.empty(int(nbytes), np.uint8)
+
+    def view(self, offset: int, nbytes: int, dtype: np.dtype,
+             shape: tuple) -> np.ndarray:
+        """A zero-copy typed window ``[offset, offset+nbytes)`` of the
+        staging region (kernels write wire bytes through it)."""
+        return self.buf[offset:offset + nbytes].view(dtype).reshape(shape)
+
+    def frame(self, nbytes: int) -> np.ndarray:
+        """The first ``nbytes`` of the staging region — the whole packed
+        data region as ONE buffer for a single-iovec send."""
+        return self.buf[:nbytes]
+
+
+def encoded_nbytes(dtype: np.dtype, size: int, codec: str) -> int:
+    """WIRE bytes one leaf of ``dtype``/``size`` occupies under ``codec``
+    — the same per-leaf encoding decision as :func:`_encode_leaf`, used
+    to size a :class:`FrameBuffer` before any kernel runs."""
+    if codec == "fp16" and dtype.kind == "f" and dtype.itemsize > 2:
+        return 2 * size
+    if codec == "int8" and dtype.kind == "f":
+        return size
+    return size * dtype.itemsize
 
 
 def _encode_leaf(arr: np.ndarray, codec: str) -> tuple[str, np.ndarray, dict]:

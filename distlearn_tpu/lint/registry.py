@@ -299,6 +299,29 @@ def _decode_family():
     return _lint_units(units, mesh)
 
 
+def _wirek_family():
+    """Fused wire-codec kernels (ops/wire_kernels): the Pallas int8
+    quantize+error-feedback and dequantize+apply calls plus the amax
+    reduction, on a wire-stripe-shaped block.  Single-device elementwise
+    programs (mesh=None, no collectives) — the lockfile pins their flops
+    and peak memory, so a regression back to a multi-pass or
+    extra-copy lowering of the codec fails tier-1, mirroring how the
+    collective budgets pin the SPMD families."""
+    import jax
+    from distlearn_tpu.ops import wire_kernels as wk
+    from distlearn_tpu.ops.flatten import LANE
+    rows = 4 * wk._BLOCK_ROWS           # 4 grid steps of the block spec
+    x = jax.ShapeDtypeStruct((rows, LANE), "float32")
+    q = jax.ShapeDtypeStruct((rows, LANE), "int8")
+    st = jax.ShapeDtypeStruct((1, 1), "float32")
+    units = [
+        ("quant_ef", wk._quant_ef_call, (x, st)),
+        ("dequant_add", wk._dequant_add_call, (x, q, st)),
+        ("wire_amax", wk._amax_call, (x,)),
+    ]
+    return _lint_units(units, None)
+
+
 def _protocol_family():
     from distlearn_tpu.lint.protocol import (async_ea_sync_schedule,
                                              check_schedules,
@@ -360,6 +383,9 @@ _FAMILIES = {
     "decode": Entry("decode",
                     "serving decode programs (continuous-batch tick + "
                     "paged prefill)", _decode_family),
+    "wirek": Entry("wirek",
+                   "fused wire-codec kernels (int8 quantize+EF / "
+                   "dequantize+apply / amax)", _wirek_family),
     "protocol": Entry("protocol",
                       "host comm schedules (tree/ring/AsyncEA) + lock audit",
                       _protocol_family),

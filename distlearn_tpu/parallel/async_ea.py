@@ -40,6 +40,7 @@ import numpy as np
 
 from distlearn_tpu import obs
 from distlearn_tpu.comm import Conn, ProtocolError, Server, connect, wire
+from distlearn_tpu.ops import wire_kernels
 from distlearn_tpu.utils.logging import print_client, print_server, print_tester
 
 PyTree = Any
@@ -456,6 +457,14 @@ class AsyncEAServer:
         self._h_shard_apply = obs.histogram(
             "async_ea_shard_apply_seconds",
             "per-stripe center apply time, by shard", labels=("shard",))
+        # fused wire path (ops/wire_kernels): resolved once per instance so
+        # in-process tests can toggle DISTLEARN_TPU_WIREK per server
+        self._wirek = wire_kernels.wirek_enabled()
+        self._h_center_apply = obs.histogram(
+            "center_apply_seconds",
+            "fused dequantize+apply of one received wire payload onto the "
+            "center (no decoded f32 copy), by stripe ('all' = whole-tree)",
+            labels=("shard",))
 
     def init_server(self, params: PyTree):
         """Clone params as center, broadcast it to every client
@@ -498,17 +507,25 @@ class AsyncEAServer:
         silently cast into the center (ADVICE r3).  ``center`` narrows the
         check to one stripe's (virtual) slice; the default checks a
         whole-tree delta against the REAL leaf layout recorded at init —
-        the published center list may be the virtual chunk view."""
+        the published center list may be the virtual chunk view.  A
+        :class:`wire.PackedPayload` (the fused-apply path receives wire
+        bytes undecoded) is checked against its manifest's LOGICAL
+        shapes/dtypes — same skew, same eviction."""
         meta = ([(tuple(t.shape), t.dtype) for t in center]
                 if center is not None else self._leaf_meta)
-        for (shape, dtype), d in zip(meta, deltas):
-            if tuple(d.shape) != shape:
+        if isinstance(deltas, wire.PackedPayload):
+            got = [(tuple(e["shape"]), np.dtype(e["dtype"]))
+                   for e in deltas.manifest["leaves"]]
+        else:
+            got = [(tuple(d.shape), d.dtype) for d in deltas]
+        for (shape, dtype), (dshape, ddtype) in zip(meta, got):
+            if dshape != shape:
                 raise ProtocolError(
-                    f"delta leaf shape {tuple(d.shape)} != center "
+                    f"delta leaf shape {dshape} != center "
                     f"{shape} — client/server model config skew")
-            if d.dtype != dtype:
+            if ddtype != dtype:
                 raise ProtocolError(
-                    f"delta leaf dtype {d.dtype} != center {dtype} — "
+                    f"delta leaf dtype {ddtype} != center {dtype} — "
                     "client/server model config skew")
 
     def _record_applied(self, cid: int, idx: int, seq: int):
@@ -523,17 +540,42 @@ class AsyncEAServer:
         if seq > seqs[idx]:
             seqs[idx] = seq
 
+    def _apply_payload_into(self, targets: list[np.ndarray],
+                            payload: "wire.PackedPayload"):
+        """Fold one undecoded wire payload into ``targets`` IN PLACE via
+        the fused dequantize+apply kernels — the decoded f32 copy the
+        numpy path materializes per leaf never exists.  Bitwise-identical
+        to ``decode_into`` + ``t += d`` (same elementwise multiply-then-
+        add, no FMA contraction — see ops/wire_kernels.py)."""
+        for t, entry, buf in zip(targets, payload.manifest["leaves"],
+                                 payload.bufs):
+            enc = entry["enc"]
+            if enc == "raw":
+                t += buf        # dtypes equal (checked) — no astype copy
+            elif enc == "int8":
+                wire_kernels.dequant_add(t, buf, entry["scale"], out=t)
+            else:               # fp16
+                wire_kernels.dequant_add(t, buf, None, out=t)
+
     def _apply_delta(self, deltas: list[np.ndarray],
                      ha: tuple[int, int] | None = None):
         """Fold a fully-received, validated delta into the center.  The
         serial server mutates in place; the concurrent subclass overrides
         this with its immutable-publish version (so the serial
         ``sync_server`` API keeps working on a concurrent server, whose
-        center leaves are frozen).  ``ha=(cid, seq)`` records the apply in
-        the exactly-once ledger (a whole-tree delta covers every stripe)."""
+        center leaves are frozen).  ``deltas`` may be an undecoded
+        :class:`wire.PackedPayload` (the fused wire path).  ``ha=(cid,
+        seq)`` records the apply in the exactly-once ledger (a whole-tree
+        delta covers every stripe)."""
         t0 = time.perf_counter() if self._obs_on else 0.0
-        for t, d in zip(self.center, deltas):
-            t += d              # dtypes equal (checked) — no astype copy
+        if isinstance(deltas, wire.PackedPayload):
+            self._apply_payload_into(self.center, deltas)
+            if self._obs_on:
+                self._h_center_apply.labels(shard="all").observe(
+                    time.perf_counter() - t0)
+        else:
+            for t, d in zip(self.center, deltas):
+                t += d          # dtypes equal (checked) — no astype copy
         if ha is not None:
             for idx in range(len(self.stripes)):
                 self._record_applied(ha[0], idx, ha[1])
@@ -577,7 +619,12 @@ class AsyncEAServer:
         conn.send_msg(DELTA)
         dl = (None if self.handshake_timeout is None
               else time.monotonic() + self.handshake_timeout)
-        deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
+        if self._wirek and codec not in (None, "raw"):
+            # fused wire path: keep the delta in wire dtype (int8 is 4x
+            # fewer bytes to hold) and dequantize inside the apply
+            deltas = conn.recv_payload(n=hi - lo, deadline=dl)
+        else:
+            deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
         self._check_delta(deltas, center=center)
         self._c_shard_syncs.labels(shard=idx).inc()
         self._c_shard_bytes.labels(shard=idx).inc(
@@ -594,9 +641,16 @@ class AsyncEAServer:
         ``ha=(cid, seq)`` marks THIS stripe of THAT sync applied."""
         lo, hi = self.stripes[idx]
         t0 = time.perf_counter() if self._obs_on else 0.0
-        for t, d in zip(self._vcenter[lo:hi], deltas):
-            t += d          # disjoint element ranges (chunk views of a
-            #                 split leaf included): threads never collide
+        if isinstance(deltas, wire.PackedPayload):
+            # fused path: wire bytes dequantize straight into the slice
+            self._apply_payload_into(self._vcenter[lo:hi], deltas)
+            if self._obs_on:
+                self._h_center_apply.labels(shard=idx).observe(
+                    time.perf_counter() - t0)
+        else:
+            for t, d in zip(self._vcenter[lo:hi], deltas):
+                t += d      # disjoint element ranges (chunk views of a
+                #             split leaf included): threads never collide
         if ha is not None:
             self._record_applied(ha[0], idx, ha[1])
         if self._obs_on:
@@ -1067,10 +1121,16 @@ class AsyncEAServer:
                         dl = (None if self.handshake_timeout is None
                               else time.monotonic() + self.handshake_timeout)
                         # auto-detects packed vs per-leaf, so a legacy
-                        # client needs no branch here; quantized deltas
-                        # decode into fresh center-dtype arrays
-                        deltas = conn.recv_tensors(n=len(self.center),
-                                                   deadline=dl)
+                        # client needs no branch here.  Fused wire path:
+                        # receive UNDECODED and dequantize inside the
+                        # apply; else quantized deltas decode into fresh
+                        # center-dtype arrays
+                        if self._wirek and codec not in (None, "raw"):
+                            deltas = conn.recv_payload(
+                                n=len(self.center), deadline=dl)
+                        else:
+                            deltas = conn.recv_tensors(n=len(self.center),
+                                                       deadline=dl)
                         self._check_delta(deltas)
                         conn.set_timeout(None)
             except (TimeoutError, ConnectionError, ProtocolError, OSError,
@@ -1331,6 +1391,11 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         self._device = pin_device
         self._dev_center = None
         self._dev_apply = None
+        # fused device applies for undecoded wire payloads, cached by the
+        # frame's per-leaf encoding signature (shapes retrace within one
+        # jit as usual) — int8 deltas cross H2D at wire width (4x fewer
+        # bytes than the decoded f32 the numpy path would ship)
+        self._dev_wire_fns: dict[tuple, Any] = {}
         # mirrors _inflight (same lock holds) so /metrics and /healthz see
         # the dispatcher's view without taking the dispatcher lock
         self._g_inflight = obs.gauge(
@@ -1380,6 +1445,31 @@ class AsyncEAServerConcurrent(AsyncEAServer):
 
         self._dev_apply = jax.jit(_apply, donate_argnums=(0,))
 
+    def _dev_wire_apply(self, center: list, payload: "wire.PackedPayload"
+                        ) -> list:
+        """Donated fused apply of an UNDECODED payload onto device leaves:
+        wire-dtype buffers go H2D as-is and dequantize on device, so the
+        host never materializes (or ships) the decoded f32 copy.  The jit
+        is cached per encoding signature; scales ride as scalar args (no
+        retrace per sync)."""
+        entries = payload.manifest["leaves"]
+        key = tuple(e["enc"] for e in entries)
+        fn = self._dev_wire_fns.get(key)
+        if fn is None:
+            def _apply(cs, bs, ss, _encs=key):
+                out = []
+                for c, b, s, enc in zip(cs, bs, ss, _encs):
+                    d = b.astype(c.dtype)
+                    if enc == "int8":
+                        d = d * s.astype(c.dtype)
+                    out.append(c + d)
+                return out
+            fn = self._dev_wire_fns[key] = jax.jit(_apply,
+                                                   donate_argnums=(0,))
+        put = [jax.device_put(b, self._device) for b in payload.bufs]
+        scales = [np.asarray(e.get("scale", 1.0)) for e in entries]
+        return fn(center, put, scales)
+
     def _snapshot_v(self) -> list[np.ndarray]:
         """The published (possibly virtual) leaf list — what stripe legs
         stream from."""
@@ -1402,7 +1492,29 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     def _apply_delta(self, deltas: list[np.ndarray],
                      ha: tuple[int, int] | None = None):
         t0 = time.perf_counter() if self._obs_on else 0.0
+        payload = deltas if isinstance(deltas, wire.PackedPayload) else None
         if self._dev_center is not None:
+            if payload is not None and len(self._stripe_locks) <= 1:
+                # fused device apply straight from wire bytes
+                with self._lock:
+                    self._dev_center = self._dev_wire_apply(
+                        self._dev_center, payload)
+                    self._sync_count += 1
+                    if ha is not None:
+                        for idx in range(len(self.stripes)):
+                            self._record_applied(ha[0], idx, ha[1])
+                if self._obs_on:
+                    self._h_center_apply.labels(shard="all").observe(
+                        time.perf_counter() - t0)
+                self._c_syncs.inc()
+                if self._obs_on:
+                    self._h_apply.observe(time.perf_counter() - t0)
+                return
+            if payload is not None:
+                # striped device center wants the VIRTUAL re-cut of real
+                # leaves — decode once (rare: unsharded client against a
+                # striped pinned server) and fall through
+                deltas = payload.decoded()
             if len(self._stripe_locks) > 1:
                 # device leaves follow the virtual layout when striped
                 deltas = wire.split_views(deltas, self.splits)
@@ -1419,7 +1531,11 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             # the serial API) through the per-stripe appliers — a
             # whole-list rebuild-and-swap here would lose a concurrent
             # sharded client's slice publish.  The wire carried REAL
-            # leaves; re-cut them to the virtual layout the stripes index.
+            # leaves; re-cut them to the virtual layout the stripes index
+            # (an undecoded payload decodes first — rare path: unsharded
+            # client against a striped concurrent server).
+            if payload is not None:
+                deltas = payload.decoded()
             vdeltas = wire.split_views(deltas, self.splits)
             with self._apply_lock:   # whole-list appliers stay ordered
                 for idx, (lo, hi) in enumerate(self.stripes):
@@ -1428,7 +1544,23 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 self._sync_count += 1
         else:
             with self._apply_lock:  # appliers serialize; readers do not wait
-                new = [t + d for t, d in zip(self.center, deltas)]
+                if payload is not None:
+                    # fused immutable publish: fresh leaf = t + dequant(b)
+                    # in one pass, never a decoded intermediate
+                    new = []
+                    for t, entry, buf in zip(self.center,
+                                             payload.manifest["leaves"],
+                                             payload.bufs):
+                        if entry["enc"] == "raw":
+                            new.append(t + buf)
+                        else:
+                            new.append(wire_kernels.dequant_add(
+                                t, buf, entry.get("scale")))
+                    if self._obs_on:
+                        self._h_center_apply.labels(shard="all").observe(
+                            time.perf_counter() - t0)
+                else:
+                    new = [t + d for t, d in zip(self.center, deltas)]
                 for t in new:
                     t.flags.writeable = False
                 with self._lock:
@@ -1455,7 +1587,20 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         without its ledger entry or vice versa."""
         lo, hi = self.stripes[idx]
         t0 = time.perf_counter() if self._obs_on else 0.0
+        payload = deltas if isinstance(deltas, wire.PackedPayload) else None
         if self._dev_center is not None:
+            if payload is not None:
+                with self._lock:
+                    self._dev_center[lo:hi] = self._dev_wire_apply(
+                        self._dev_center[lo:hi], payload)
+                    if ha is not None:
+                        self._record_applied(ha[0], idx, ha[1])
+                if self._obs_on:
+                    self._h_center_apply.labels(shard=idx).observe(
+                        time.perf_counter() - t0)
+                    self._h_shard_apply.labels(shard=idx).observe(
+                        time.perf_counter() - t0)
+                return
             put = [jax.device_put(d, self._device) for d in deltas]
             with self._lock:
                 self._dev_center[lo:hi] = self._dev_apply(
@@ -1467,7 +1612,23 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             with stripe_locks[idx]:
                 # entries [lo, hi) only change under this stripe's lock,
                 # so reading them outside the pointer lock is stable
-                new = [t + d for t, d in zip(self.center[lo:hi], deltas)]
+                if payload is not None:
+                    # fused immutable publish, straight from wire bytes
+                    new = []
+                    for t, entry, buf in zip(self.center[lo:hi],
+                                             payload.manifest["leaves"],
+                                             payload.bufs):
+                        if entry["enc"] == "raw":
+                            new.append(t + buf)
+                        else:
+                            new.append(wire_kernels.dequant_add(
+                                t, buf, entry.get("scale")))
+                    if self._obs_on:
+                        self._h_center_apply.labels(shard=idx).observe(
+                            time.perf_counter() - t0)
+                else:
+                    new = [t + d
+                           for t, d in zip(self.center[lo:hi], deltas)]
                 for t in new:
                     t.flags.writeable = False
                 with self._lock:
@@ -1843,7 +2004,14 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                             dl = (None if self.handshake_timeout is None
                                   else time.monotonic()
                                   + self.handshake_timeout)
-                            if self._dev_center is None:
+                            if (self._wirek
+                                    and codec not in (None, "raw")):
+                                # fused wire path: the delta stays in
+                                # wire dtype until the apply dequantizes
+                                # it (device path: H2D at wire width)
+                                deltas = conn.recv_payload(
+                                    n=len(self._leaf_meta), deadline=dl)
+                            elif self._dev_center is None:
                                 if bufs is None:
                                     # REAL leaf layout: a legacy client's
                                     # delta is per-leaf whatever the
@@ -2112,6 +2280,19 @@ class AsyncEAClient:
         self._seq = 0
         self._pending: tuple[int, list, list] | None = None
         self._last_reply: dict | None = None
+        # fused wire path (ops/wire_kernels): resolved once per instance
+        # so in-process tests can toggle DISTLEARN_TPU_WIREK per client
+        self._wirek = wire_kernels.wirek_enabled()
+        # per-stripe reusable staging: frame buffers the fused kernels
+        # write wire bytes into (one iovec per send, no per-sync alloc)
+        # and decode scratch for the numpy fallback's residual
+        self._framebufs: list[wire.FrameBuffer] = []
+        self._dec_scratch: dict[int, list[np.ndarray]] = {}
+        self._obs_on = obs.enabled()
+        self._h_encode = obs.histogram(
+            "wire_encode_seconds",
+            "one stripe's delta encode (quantize + error-feedback "
+            "residual), by stripe", labels=("shard",))
         self._c_redials = obs.counter(
             "async_ea_failover_redials_total",
             "failover re-dial attempts (per candidate center tried)")
@@ -2327,8 +2508,8 @@ class AsyncEAClient:
                     enc_res = wire.split_views(self._residuals,
                                                self._splits)
             bounds = self._stripes if striped else [(0, len(enc_deltas))]
-            payloads = [self._encode_stripe(enc_deltas, enc_res, lo, hi)
-                        for lo, hi in bounds]
+            payloads = [self._encode_stripe(enc_deltas, enc_res, lo, hi, i)
+                        for i, (lo, hi) in enumerate(bounds)]
             # keep the encoded bytes until the next sync: if the center
             # dies with this delta partially applied, the failover rejoin
             # replays exactly the stripes the server never saw
@@ -2368,7 +2549,7 @@ class AsyncEAClient:
 
     def _encode_stripe(self, deltas: list[np.ndarray],
                        residuals: list[np.ndarray] | None,
-                       lo: int, hi: int):
+                       lo: int, hi: int, idx: int = 0):
         """Encode one stripe's delta slice for the packed wire.  Error
         feedback (Seide et al. 2014) for lossy codecs: quantize delta +
         carried residual, keep the quantization error for the next round —
@@ -2376,16 +2557,41 @@ class AsyncEAClient:
         the fp32 fixed point.  ``deltas``/``residuals`` are the lists the
         stripe plan indexes (the virtual chunk views when striped) —
         residual chunks view the full-length per-leaf arrays, so
-        per-stripe state stays exact under any plan."""
+        per-stripe state stays exact under any plan.
+
+        Fused path (``DISTLEARN_TPU_WIREK``, default on): ONE kernel pass
+        per leaf produces q, scale, and ``r = d - dequant(q)`` straight
+        into stripe ``idx``'s reusable frame buffer — no encode-then-
+        decode double walk, no per-sync allocation, one iovec on the
+        wire.  Bitwise-identical to the numpy path (ops/wire_kernels.py
+        carries the proof), which the fallback keeps."""
         sl = deltas[lo:hi]
         if self.codec == "raw":
             return wire.encode_leaves(sl, "raw")
+        t0 = time.perf_counter() if self._obs_on else 0.0
         res = residuals[lo:hi]
         for d, r in zip(sl, res):
             d += r
-        payload = wire.encode_leaves(sl, self.codec)
-        for r, d, dec in zip(res, sl, payload.decoded()):
-            np.subtract(d, dec, out=r)
+        if self._wirek:
+            while len(self._framebufs) <= idx:
+                self._framebufs.append(wire.FrameBuffer())
+            payload = wire_kernels.encode_ef_into(
+                sl, res, self.codec, out=self._framebufs[idx])
+        else:
+            payload = wire.encode_leaves(sl, self.codec)
+            # decode into per-stripe reusable scratch (not fresh arrays):
+            # the residual walk allocates nothing in steady state
+            sc = self._dec_scratch.get(idx)
+            if (sc is None or len(sc) != len(sl)
+                    or any(s.shape != d.shape or s.dtype != d.dtype
+                           for s, d in zip(sc, sl))):
+                sc = self._dec_scratch[idx] = [np.empty_like(d)
+                                               for d in sl]
+            for r, d, dec in zip(res, sl, payload.decoded_into(sc)):
+                np.subtract(d, dec, out=r)
+        if self._obs_on:
+            self._h_encode.labels(shard=idx).observe(
+                time.perf_counter() - t0)
         return payload
 
     def _rejoin_handshake(self, n_leaves: int, retries: int,

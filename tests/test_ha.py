@@ -7,6 +7,7 @@ the failover table from the obs trail."""
 from __future__ import annotations
 
 import json
+import math
 import os
 import signal
 import sys
@@ -292,3 +293,51 @@ def test_diststat_failover_table_empty_without_activity(tmp_path):
         {"type": "snapshot", "ts": 1.0, "metrics": [
             _counter("async_ea_syncs_total", 5)]}) + "\n")
     assert diststat.summarize_run([str(log)])["failover"] == {}
+
+
+# ------------------------------------------------ diststat codec table
+
+def _histogram(name, rows, labelnames=("shard",)):
+    return {"name": name, "kind": "histogram", "help": "",
+            "labelnames": list(labelnames),
+            "samples": [{"labels": lb, "sum": s, "count": c}
+                        for lb, s, c in rows]}
+
+
+def test_diststat_codec_table(tmp_path):
+    recs = [
+        {"type": "snapshot", "ts": 2.0, "metrics": [
+            _histogram("wire_encode_seconds",
+                       [({"shard": "0"}, 0.4, 4),
+                        ({"shard": "1"}, 0.2, 2),
+                        ({"shard": "all"}, 0.9, 3)]),
+            _histogram("center_apply_seconds",
+                       [({"shard": "0"}, 0.08, 4),
+                        ({"shard": "all"}, 0.3, 3)]),
+            {"name": "wire_zero_copy_total", "kind": "counter",
+             "help": "", "labelnames": ["result"],
+             "samples": [{"labels": {"result": "hit"}, "value": 9},
+                         {"labels": {"result": "miss"}, "value": 1}]},
+        ]},
+    ]
+    log = tmp_path / "run.jsonl"
+    log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    tab = diststat.summarize_run([str(log)])["codec"]
+    st = tab["stripes"]
+    assert list(st) == ["0", "1", "all"]
+    assert st["0"]["encodes"] == 4
+    assert st["0"]["encode_mean"] == pytest.approx(0.1)
+    assert st["0"]["applies"] == 4
+    assert st["0"]["apply_mean"] == pytest.approx(0.02)
+    assert st["1"]["encodes"] == 2 and st["1"]["applies"] == 0
+    assert math.isnan(st["1"]["apply_mean"])
+    assert st["all"]["encode_mean"] == pytest.approx(0.3)
+    assert tab["zero_copy"] == {"hit": 9, "miss": 1, "hit_ratio": 0.9}
+
+
+def test_diststat_codec_table_empty_without_fused_activity(tmp_path):
+    log = tmp_path / "run.jsonl"
+    log.write_text(json.dumps(
+        {"type": "snapshot", "ts": 1.0, "metrics": [
+            _counter("async_ea_syncs_total", 5)]}) + "\n")
+    assert diststat.summarize_run([str(log)])["codec"] == {}

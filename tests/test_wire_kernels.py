@@ -1,0 +1,513 @@
+"""Fused wire-codec kernel tests (ISSUE 12): randomized round-trip
+property tests against the committed numpy reference encoding, bitwise
+parity of the blocked host kernels, <=1-ulp bounds on the Pallas route,
+zero-copy/zero-alloc assertions for the staging fast path, undecoded
+``recv_payload`` transport behavior, and the 50-round EASGD trajectory
+parity acceptance (fused vs numpy, S=1 and S=4).
+"""
+
+import os
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from distlearn_tpu.comm import transport, wire
+from distlearn_tpu.ops import wire_kernels as wk
+from distlearn_tpu.ops import wire_native
+from distlearn_tpu.utils.logging import set_verbose
+
+set_verbose(False)
+
+from tests.net_util import reserve_port_window
+
+pytestmark = pytest.mark.perf
+
+
+def _ref_int8(arr):
+    """The committed reference encoding + residual: encode_leaves ->
+    decoded -> subtract (the exact path _encode_stripe used pre-fusion)."""
+    payload = wire.encode_leaves([arr], "int8")
+    dec = payload.decoded()[0]
+    return (payload.bufs[0], payload.manifest["leaves"][0].get("scale"),
+            np.subtract(arr, dec))
+
+
+# ---------------------------------------------------------------------------
+# Blocked host kernels: bitwise parity with the numpy reference.
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((1000,), np.float32), ((3, 5, 7), np.float32), ((0,), np.float32),
+    ((1,), np.float32), ((257, 129), np.float32), ((513,), np.float64),
+    ((300001,), np.float32),      # > _CHUNK: crosses a block boundary
+])
+def test_quantize_ef_bitwise_vs_reference(shape, dtype):
+    rng = np.random.default_rng(hash((shape, np.dtype(dtype).name)) % 2**31)
+    d = (rng.standard_normal(shape) * 3).astype(dtype)
+    q = np.empty(shape, np.int8)
+    r = np.empty(shape, dtype)
+    scale = wk.quantize_ef_into(d.copy(), q, r)
+    q_ref, s_ref, r_ref = _ref_int8(d)
+    assert scale == s_ref                       # python-float, exact
+    np.testing.assert_array_equal(q, q_ref)
+    np.testing.assert_array_equal(r, r_ref)
+
+
+def test_quantize_ef_scale_zero_carries_whole_delta():
+    d = np.zeros(64, np.float32)
+    q = np.empty(64, np.int8)
+    r = np.empty(64, np.float32)
+    assert wk.quantize_ef_into(d, q, r) == 0.0
+    assert not q.any() and not r.any()
+    # all-zero amax but nonzero input cannot happen; denormal-small does:
+    d = np.full(64, 1e-42, np.float32)
+    scale = wk.quantize_ef_into(d, q, r)
+    q_ref, s_ref, r_ref = _ref_int8(d)
+    assert scale == s_ref
+    np.testing.assert_array_equal(q, q_ref)
+    np.testing.assert_array_equal(r, r_ref)
+
+
+@pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+def test_quantize_ef_nonfinite_raises_like_reference(bad):
+    d = np.ones(130000, np.float32)
+    d[129999] = bad                              # in the LAST chunk
+    q = np.empty_like(d, dtype=np.int8)
+    r = np.empty_like(d)
+    with pytest.raises(ValueError, match="non-finite"):
+        wk.quantize_ef_into(d, q, r)
+    with pytest.raises(ValueError, match="non-finite"):
+        wire.encode_leaves([d], "int8")
+
+
+def test_fp16_ef_bitwise_vs_reference():
+    rng = np.random.default_rng(7)
+    d = (rng.standard_normal(3001) * 10).astype(np.float32)
+    h = np.empty_like(d, dtype=np.float16)
+    r = np.empty_like(d)
+    wk.fp16_ef_into(d, h, r)
+    payload = wire.encode_leaves([d], "fp16")
+    np.testing.assert_array_equal(h, payload.bufs[0])
+    np.testing.assert_array_equal(r, d - payload.decoded()[0])
+
+
+@pytest.mark.parametrize("scale", [0.037, None])
+def test_dequant_add_matches_decode_then_add(scale):
+    rng = np.random.default_rng(11)
+    t = rng.standard_normal(200003).astype(np.float32)
+    if scale is None:
+        buf = rng.standard_normal(t.shape).astype(np.float16)
+        entry = {"enc": "fp16", "dtype": "float32"}
+    else:
+        buf = rng.integers(-127, 128, t.shape).astype(np.int8)
+        entry = {"enc": "int8", "dtype": "float32", "scale": scale}
+    dec = np.empty_like(t)
+    wire.decode_into(entry, buf, dec)
+    want = t + dec
+    got = wk.dequant_add(t, buf, scale)          # fresh
+    np.testing.assert_array_equal(got, want)
+    wk.dequant_add(t, buf, scale, out=t)         # in place, aliasing t
+    np.testing.assert_array_equal(t, want)
+
+
+# ---------------------------------------------------------------------------
+# Randomized round-trip property tests over whole payloads.
+
+@pytest.mark.parametrize("codec", ["int8", "fp16"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_encode_ef_into_randomized_parity(codec, seed):
+    """Mixed raw/quantized frames, non-contiguous and zero-size leaves,
+    f32/f64, with and without the frame buffer: manifest and wire bytes
+    byte-identical to encode_leaves, residuals == d - decoded()."""
+    rng = np.random.default_rng(seed)
+    big = rng.standard_normal((64, 64)).astype(np.float32)
+    leaves = [
+        (rng.standard_normal(977) * 5).astype(np.float32),
+        np.arange(17, dtype=np.int32),           # rides raw in any codec
+        big[::2, ::2],                           # NON-contiguous view
+        np.empty((0, 4), np.float32),            # zero-size
+        rng.standard_normal((3, 1, 9)).astype(np.float64),
+        np.zeros(33, np.float32),                # scale == 0
+    ]
+    ref = wire.encode_leaves(leaves, codec)
+    ref_dec = ref.decoded()
+    for use_fb in (False, True):
+        res = [np.full(l.shape, np.nan, l.dtype if l.dtype.kind == "f"
+                       else np.float32) for l in leaves]
+        fb = wire.FrameBuffer() if use_fb else None
+        payload = wk.encode_ef_into(leaves, res, codec, out=fb)
+        assert payload.manifest == ref.manifest
+        for buf, rbuf in zip(payload.bufs, ref.bufs):
+            np.testing.assert_array_equal(np.asarray(buf),
+                                          np.asarray(rbuf))
+        for l, r, dec in zip(leaves, res, ref_dec):
+            want = (np.asarray(l, r.dtype) - dec if l.dtype.kind == "f"
+                    else np.zeros(l.shape, r.dtype))
+            np.testing.assert_array_equal(r, want)
+        if use_fb:
+            assert payload.frame is not None
+            cat = (np.concatenate([np.asarray(b).reshape(-1).view(np.uint8)
+                                   for b in ref.bufs if b.nbytes])
+                   if ref.wire_nbytes else np.empty(0, np.uint8))
+            np.testing.assert_array_equal(payload.frame, cat)
+        else:
+            assert payload.frame is None
+
+
+def test_encode_ef_into_rejects_raw():
+    with pytest.raises(ValueError, match="lossy"):
+        wk.encode_ef_into([np.zeros(3, np.float32)],
+                          [np.zeros(3, np.float32)], "raw")
+
+
+# ---------------------------------------------------------------------------
+# Pallas route (interpret mode on CPU): wire-visible outputs bitwise,
+# residual within 1 ulp (XLA may contract the dequant-subtract to FMA).
+
+def _assert_within_one_ulp_of(got, want, magnitude):
+    """|got - want| bounded per element by one ulp AT THE MAGNITUDE of the
+    contracted product — the only drift FMA contraction can introduce
+    (a plain int-representation diff misbehaves across zero crossings,
+    where a 1-ulp-of-|x| error spans many representable tiny floats)."""
+    tol = np.spacing(np.abs(magnitude).astype(np.float32))
+    bad = np.abs(got - want) > tol
+    assert not bad.any(), (
+        f"{bad.sum()} elements beyond 1 ulp; worst "
+        f"{np.abs(got - want).max()} vs tol {tol.max()}")
+
+
+@pytest.mark.parametrize("n", [1, 5000, wk._TILE_Q])
+def test_quantize_ef_jax_q_scale_bitwise_r_one_ulp(n):
+    rng = np.random.default_rng(n)
+    d = (rng.standard_normal(n) * 2).astype(np.float32)
+    q, scale, r = wk.quantize_ef_jax(d)
+    q_ref, s_ref, r_ref = _ref_int8(d)
+    assert scale == s_ref
+    np.testing.assert_array_equal(q, q_ref)
+    _assert_within_one_ulp_of(r.astype(np.float32),
+                              r_ref.astype(np.float32), d)
+
+
+def test_dequant_add_jax_one_ulp():
+    rng = np.random.default_rng(5)
+    t = rng.standard_normal(4100).astype(np.float32)
+    q = rng.integers(-127, 128, t.shape).astype(np.int8)
+    got = wk.dequant_add_jax(t, q, 0.021)
+    want = wk.dequant_add(t, q, 0.021)
+    # two-rounding (mul, add) vs one-rounding (FMA): bounded by one ulp
+    # at the magnitude of the larger intermediate, |t| + |q*scale|
+    mag = np.abs(t) + np.abs(q.astype(np.float32) * 0.021)
+    _assert_within_one_ulp_of(got, want, mag)
+
+
+def test_quantize_ef_jax_nonfinite_raises():
+    with pytest.raises(ValueError, match="non-finite"):
+        wk.quantize_ef_jax(np.array([1.0, np.nan], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy staging (satellite: encode_leaves raw leaves are views).
+
+def test_encode_leaves_raw_contiguous_is_zero_copy():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    payload = wire.encode_leaves([a], "raw")
+    assert payload.bufs[0] is a                 # no ascontiguousarray copy
+    assert np.shares_memory(payload.bufs[0], a)
+    # int leaves ride raw inside a quantized frame — still zero-copy
+    b = np.arange(9, dtype=np.int64)
+    payload = wire.encode_leaves([np.zeros(4, np.float32), b], "int8")
+    assert payload.bufs[1] is b
+    # non-contiguous inputs are the one case that must copy
+    v = a[:, ::2]
+    payload = wire.encode_leaves([v], "raw")
+    assert not np.shares_memory(payload.bufs[0], a)
+
+
+def test_frame_buffer_reserve_and_views():
+    fb = wire.FrameBuffer()
+    fb.reserve(100)
+    buf0 = fb.buf
+    fb.reserve(50)                               # grow-never-shrink
+    assert fb.buf is buf0
+    v = fb.view(4, 8, np.dtype(np.float32), (2,))
+    v[...] = [1.5, -2.0]
+    assert np.shares_memory(v, fb.buf)
+    np.testing.assert_array_equal(
+        fb.frame(12)[4:].view(np.float32), [1.5, -2.0])
+
+
+def test_encode_ef_into_frame_buffer_reused_across_syncs():
+    rng = np.random.default_rng(3)
+    fb = wire.FrameBuffer()
+    leaves = [rng.standard_normal(500).astype(np.float32)]
+    res = [np.zeros(500, np.float32)]
+    p1 = wk.encode_ef_into(leaves, res, "int8", out=fb)
+    buf_before = fb.buf
+    leaves[0][...] = rng.standard_normal(500).astype(np.float32)
+    p2 = wk.encode_ef_into(leaves, res, "int8", out=fb)
+    assert fb.buf is buf_before                  # no per-sync realloc
+    assert np.shares_memory(np.asarray(p1.bufs[0]), fb.buf)
+    assert np.shares_memory(np.asarray(p2.bufs[0]), fb.buf)
+
+
+# ---------------------------------------------------------------------------
+# Zero steady-state allocation (satellite: decoded_into + fused paths).
+
+def test_steady_state_sync_math_allocates_nothing():
+    """The residual walk (fused encode) and the center apply
+    (decoded_into / dequant_add with out=) must allocate nothing once
+    warm — tracemalloc-asserted, mirroring the obs NULL-object test."""
+    rng = np.random.default_rng(9)
+    d0 = rng.standard_normal(5000).astype(np.float32)
+    d = d0.copy()
+    q = np.empty_like(d, dtype=np.int8)
+    r = np.empty_like(d)
+    t = rng.standard_normal(5000).astype(np.float32)
+    entry = {"enc": "int8", "dtype": "float32", "scale": 0.03}
+    scratch = np.empty_like(t)
+
+    def run(n):
+        for _ in range(n):
+            wk.quantize_ef_into(d, q, r)
+            wk.dequant_add(t, q, 0.03, out=t)
+            wire.decode_into(entry, q, scratch)
+
+    run(10)                                      # warm caches / scratch
+    tracemalloc.start()
+    # One-time allocations (free-list growth, interpreter caches,
+    # tracemalloc's own bookkeeping) can land in ANY early window
+    # depending on what the rest of the suite ran first, so absorb
+    # adaptively: a per-call leak can never produce a zero window, a
+    # one-time blip always leaves the next window clean.
+    delta = None
+    for _ in range(4):
+        run(10)
+        before = tracemalloc.get_traced_memory()[0]
+        run(50)
+        delta = tracemalloc.get_traced_memory()[0] - before
+        if delta == 0:
+            break
+    tracemalloc.stop()
+    assert delta == 0
+
+
+def test_decoded_into_reuses_buffers():
+    rng = np.random.default_rng(2)
+    leaves = [rng.standard_normal(100).astype(np.float32),
+              np.arange(5, dtype=np.int32)]
+    payload = wire.encode_leaves(leaves, "int8")
+    out = [np.empty(100, np.float32), np.empty(5, np.int32)]
+    dec = payload.decoded_into(out)
+    assert dec[0] is out[0]                      # quantized -> decoded into
+    assert dec[1] is payload.bufs[1]             # raw -> the wire view
+    np.testing.assert_array_equal(dec[0], payload.decoded()[0])
+
+
+# ---------------------------------------------------------------------------
+# Transport: single-iovec frame sends and undecoded receives.
+
+def test_send_packed_frame_and_recv_payload_loopback():
+    srv = transport.Server("127.0.0.1", reserve_port_window(1))
+    out = {}
+
+    def server():
+        c = srv.accept()[0]
+        out["fb"] = c.recv_payload(n=3)
+        out["gather"] = c.recv_payload(n=3)
+        out["legacy"] = c.recv_payload(n=1)
+        out["empty"] = c.recv_payload(n=0)
+        c.close()
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    c = transport.connect("127.0.0.1", srv.sock.getsockname()[1])
+    rng = np.random.default_rng(0)
+    leaves = [rng.standard_normal(97).astype(np.float32),
+              np.arange(10, dtype=np.int32),
+              rng.standard_normal((3, 5)).astype(np.float32)]
+    res = [np.zeros_like(l, dtype=np.float32) for l in leaves]
+    fb = wire.FrameBuffer()
+    pay = wk.encode_ef_into(leaves, res, "int8", out=fb)
+    assert pay.frame is not None
+    c.send_packed(pay)                           # single-iovec frame send
+    c.send_packed(wire.encode_leaves(leaves, "int8"))   # per-leaf gather
+    c.send_tensor(leaves[0])                     # legacy 'T'
+    th.join(timeout=30)
+    assert not th.is_alive()
+    c.close()
+    srv.close()
+    for key in ("fb", "gather"):
+        got = out[key]
+        assert got.manifest == pay.manifest
+        assert got.codec == "int8"
+        for b, bref in zip(got.bufs, pay.bufs):
+            np.testing.assert_array_equal(b, np.asarray(bref))
+        assert got.logical_nbytes == sum(l.nbytes for l in leaves)
+    leg = out["legacy"]
+    assert leg.codec == "raw"
+    np.testing.assert_array_equal(leg.bufs[0], leaves[0])
+    assert out["empty"].bufs == []
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 50-round EASGD trajectory identical fused vs numpy.
+
+def _toggle_wirek(monkeypatch, on: bool):
+    monkeypatch.setenv("DISTLEARN_TPU_WIREK", "1" if on else "0")
+
+
+def test_fifty_round_trajectory_parity_s1(monkeypatch):
+    """50 int8-EA rounds, serial S=1: the fused kernels and the numpy
+    reference path produce BITWISE-identical centers — the fused codec is
+    a pure perf change, zero math drift."""
+    from tests.test_async_ea_wire import _run_ea
+    _toggle_wirek(monkeypatch, False)
+    ref = _run_ea(reserve_port_window(8), "int8")
+    _toggle_wirek(monkeypatch, True)
+    fused = _run_ea(reserve_port_window(8), "int8")
+    np.testing.assert_array_equal(ref, fused)
+
+
+def test_fifty_round_trajectory_parity_s4(monkeypatch):
+    """50 int8-EA rounds on the S=4 striped concurrent pipeline: fused vs
+    numpy bitwise parity — per-stripe frame buffers, the undecoded
+    recv_payload leg, and the fused stripe apply all preserve the exact
+    trajectory."""
+    from distlearn_tpu.parallel.async_ea import (AsyncEAClient,
+                                                 AsyncEAServerConcurrent)
+
+    def run(rounds=50):
+        port = reserve_port_window(12)
+        out = {}
+
+        def client_fn():
+            c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                              codec="int8")
+            p = c.init_client({"w": np.zeros((8, 5), np.float32),
+                               "b": np.zeros((3,), np.float32)})
+            for r in range(rounds):
+                p = {k: v + (r % 5) + 0.25 for k, v in p.items()}
+                p, synced = c.sync_client(p)
+                assert synced
+            out["p"] = p
+            c.close()
+
+        th = threading.Thread(target=client_fn, daemon=True)
+        th.start()
+        srv = AsyncEAServerConcurrent("127.0.0.1", port, num_nodes=1,
+                                      shards=4)
+        srv.init_server({"w": np.zeros((8, 5), np.float32),
+                         "b": np.zeros((3,), np.float32)})
+        srv.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if srv.syncs_completed >= rounds and srv.drained:
+                break
+            time.sleep(0.01)
+        th.join(timeout=60)
+        assert not th.is_alive(), "client hung"
+        assert srv.syncs_completed == rounds
+        center = [np.array(t) for t in srv._snapshot()]
+        srv.stop()
+        srv.close()
+        return out["p"], center
+
+    _toggle_wirek(monkeypatch, False)
+    p_ref, c_ref = run()
+    _toggle_wirek(monkeypatch, True)
+    p_fused, c_fused = run()
+    for a, b in zip(c_ref, c_fused):
+        np.testing.assert_array_equal(a, b)
+    for k in p_ref:
+        np.testing.assert_array_equal(p_ref[k], p_fused[k])
+
+
+def test_wirek_env_gate_pins_numpy_path(monkeypatch):
+    _toggle_wirek(monkeypatch, False)
+    assert wk.wirek_enabled() is False
+    _toggle_wirek(monkeypatch, True)
+    assert wk.wirek_enabled() is True
+    assert wk.wirek_enabled(override=False) is False
+    monkeypatch.delenv("DISTLEARN_TPU_WIREK")
+    assert wk.wirek_enabled() is True            # default on
+
+
+# ---------------------------------------------------------------------------
+# Native (compiled C) backend: must agree bitwise with the blocked-numpy
+# tier, and must degrade silently when disabled/unavailable.
+
+_needs_native = pytest.mark.skipif(
+    not wire_native.available(),
+    reason=f"native wire codec unavailable: {wire_native.why_unavailable()}")
+
+
+@_needs_native
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_native_vs_blocked_bitwise(monkeypatch, seed):
+    """The two host tiers are interchangeable bit for bit: quantize the
+    same delta with the C kernel and with the blocked numpy loop (pinned
+    via DISTLEARN_TPU_WIREC=0) and compare q/scale/r — then the same for
+    the fused apply, fresh and in-place."""
+    rng = np.random.default_rng(seed)
+    d = (rng.standard_normal(40013) * 10.0 ** rng.integers(-12, 12)
+         ).astype(np.float32)
+    q_n = np.empty(d.size, np.int8)
+    r_n = np.empty_like(d)
+    assert wire_native.usable_quant(d, q_n, r_n)
+    s_n = wk.quantize_ef_into(d.copy(), q_n, r_n)
+
+    monkeypatch.setenv("DISTLEARN_TPU_WIREC", "0")
+    assert not wire_native.available()
+    q_b = np.empty(d.size, np.int8)
+    r_b = np.empty_like(d)
+    s_b = wk.quantize_ef_into(d.copy(), q_b, r_b)
+    assert s_n == s_b
+    np.testing.assert_array_equal(q_n, q_b)
+    np.testing.assert_array_equal(r_n, r_b)
+
+    t = rng.standard_normal(d.size).astype(np.float32)
+    blocked_fresh = wk.dequant_add(t, q_b, s_b)
+    blocked_inpl = t.copy()
+    wk.dequant_add(blocked_inpl, q_b, s_b, out=blocked_inpl)
+    monkeypatch.delenv("DISTLEARN_TPU_WIREC")
+    native_fresh = wk.dequant_add(t, q_n, s_n)
+    native_inpl = t.copy()
+    wk.dequant_add(native_inpl, q_n, s_n, out=native_inpl)
+    np.testing.assert_array_equal(native_fresh, blocked_fresh)
+    np.testing.assert_array_equal(native_inpl, blocked_inpl)
+
+
+@_needs_native
+def test_native_partial_overlap_falls_back():
+    """A partially-overlapping out/t pair would break the C kernel's
+    restrict contract — dequant_add must detect it and take the numpy
+    route (whose ufuncs are overlap-safe)."""
+    base = np.zeros(150, np.float32)
+    base[:100] = np.arange(100, dtype=np.float32)
+    t = base[:100]
+    out = base[50:150]
+    q = np.full(100, 3, np.int8)
+    want = t.copy() + q * np.float32(0.5)
+    got = wk.dequant_add(t, q, 0.5, out=out)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_native_gate_and_usability(monkeypatch):
+    monkeypatch.setenv("DISTLEARN_TPU_WIREC", "0")
+    assert wire_native.available() is False
+    assert "disabled" in wire_native.why_unavailable()
+    d = np.zeros(8, np.float32)
+    assert not wire_native.usable_quant(d, np.zeros(8, np.int8), d.copy())
+    monkeypatch.delenv("DISTLEARN_TPU_WIREC")
+    if wire_native.available():
+        assert wire_native.why_unavailable() is None
+        # non-contiguous / wrong-dtype inputs must route to numpy
+        big = np.zeros((8, 8), np.float32)
+        assert not wire_native.usable_quant(
+            big[::2, ::2], np.zeros((4, 4), np.int8),
+            np.zeros((4, 4), np.float32))
+        d64 = np.zeros(8, np.float64)
+        assert not wire_native.usable_quant(
+            d64, np.zeros(8, np.int8), d64.copy())

@@ -165,6 +165,51 @@ def shard_table(counters: dict, histograms: dict) -> dict:
     return dict(sorted(tab.items(), key=lambda kv: (len(kv[0]), kv[0])))
 
 
+_ENCODE_HIST = "wire_encode_seconds"
+_APPLY_HIST = "center_apply_seconds"
+_ZC_FAM = "wire_zero_copy_total"
+
+
+def codec_table(counters: dict, histograms: dict) -> dict:
+    """Derive the fused wire-codec table: per stripe ('all' = whole-tree),
+    the client-side encode (quantize + error-feedback) and server-side
+    apply (dequantize + elastic add) histograms, plus the zero-copy
+    staging hit ratio from ``wire_zero_copy_total`` (hit = one contiguous
+    frame-buffer iovec per send, miss = per-leaf gather; only client
+    delta-up sends stage, so a healthy EASGD fleet sits near 0.5 —
+    docs/OBSERVABILITY.md).  Empty when the run never took the fused
+    path — so the table doubles as the is-the-fast-path-actually-on
+    check for production runs."""
+    stripes: dict[str, dict] = {}
+
+    def row(shard):
+        return stripes.setdefault(shard, {
+            "encodes": 0, "encode_mean": float("nan"),
+            "applies": 0, "apply_mean": float("nan")})
+
+    for key, h in histograms.items():
+        s = _shard_label(key, _ENCODE_HIST)
+        if s is not None and h["count"]:
+            r = row(s)
+            r["encodes"] += h["count"]
+            r["encode_mean"] = h["sum"] / h["count"]
+        s = _shard_label(key, _APPLY_HIST)
+        if s is not None and h["count"]:
+            r = row(s)
+            r["applies"] += h["count"]
+            r["apply_mean"] = h["sum"] / h["count"]
+    out: dict = {}
+    if stripes:
+        out["stripes"] = dict(sorted(stripes.items(),
+                                     key=lambda kv: (len(kv[0]), kv[0])))
+    hit = counters.get(_ZC_FAM + '{result="hit"}', 0.0)
+    miss = counters.get(_ZC_FAM + '{result="miss"}', 0.0)
+    if hit or miss:
+        out["zero_copy"] = {"hit": hit, "miss": miss,
+                            "hit_ratio": hit / (hit + miss)}
+    return out
+
+
 _FAILOVER_COUNTERS = {
     "async_ea_evictions_total": "evictions",
     "async_ea_rejoins_total": "rejoins",
@@ -264,6 +309,7 @@ def summarize_run(paths: list[str]) -> dict:
             "gauges": dict(sorted(run["gauges"].items())),
             "histograms": hist_tab,
             "wire": wire_table(run["counters"]),
+            "codec": codec_table(run["counters"], run["histograms"]),
             "shards": shard_table(run["counters"], run["histograms"]),
             "failover": failover_table(run["counter_totals"],
                                        run["counters"], run["spans"]),
@@ -351,6 +397,21 @@ def _print_summary(doc: dict):
             print(f"{codec:<12} {row['frames']:>8g} "
                   f"{row['wire_bytes']:>14g} {row['logical_bytes']:>14g} "
                   f"{row['ratio']:>7.2f}")
+        print()
+    if doc.get("codec"):
+        cd = doc["codec"]
+        if cd.get("stripes"):
+            print(f"{'codec stripe':<12} {'encodes':>9} {'encode mean':>13} "
+                  f"{'applies':>9} {'apply mean':>12}")
+            for shard, row in cd["stripes"].items():
+                print(f"{shard:<12} {row['encodes']:>9g} "
+                      f"{_fmt_s(row['encode_mean']):>13} "
+                      f"{row['applies']:>9g} "
+                      f"{_fmt_s(row['apply_mean']):>12}")
+        if cd.get("zero_copy"):
+            z = cd["zero_copy"]
+            print(f"zero-copy frames: hit={z['hit']:g} miss={z['miss']:g} "
+                  f"hit_ratio={z['hit_ratio']:.2f}")
         print()
     if doc.get("shards"):
         print(f"{'shard':<8} {'legs':>8} {'wire bytes':>14} "
