@@ -6,8 +6,9 @@ plane for the asynchronous parameter-server path and multi-host side-channel.
 
 from distlearn_tpu.comm import wire
 from distlearn_tpu.comm.errors import PeerClosed
+from distlearn_tpu.comm.faults import FaultInjected, FaultPlan
 from distlearn_tpu.comm.transport import Conn, Server, connect, ProtocolError
 from distlearn_tpu.comm.ring import LocalhostRing, Ring
 
 __all__ = ["Conn", "Server", "connect", "PeerClosed", "ProtocolError", "Ring",
-           "LocalhostRing", "wire"]
+           "LocalhostRing", "wire", "FaultPlan", "FaultInjected"]

@@ -127,6 +127,12 @@ class Conn:
         self.bytes_sent = 0
         self.bytes_received = 0
         self.throttle_bps: float | None = None
+        # Force the pure-Python socket path for this conn even when the
+        # native backend is built.  The native loops do IO on the raw fd,
+        # which bypasses any proxy installed over ``self.sock`` — the
+        # fault-injection layer (comm/faults.py) flips this so its socket
+        # wrapper actually sees every byte.
+        self.force_py_io = False
         self._rx = bytearray()        # recv_serve_nowait partial-frame buffer
         self._rx_eof = False
         # Telemetry handles resolve once per connection (obs.NULL when the
@@ -200,7 +206,7 @@ class Conn:
     def _send_frame(self, kind: int, payload: bytes | memoryview):
         t0 = time.perf_counter()
         try:
-            if native.available():
+            if native.available() and not self.force_py_io:
                 native.send_frame(self._fd, kind, payload)
             else:
                 self._sendv([_HDR.pack(kind, len(payload)), payload])
@@ -263,7 +269,7 @@ class Conn:
             self._m_recv.inc(n)
             return buf
         try:
-            if native.available():
+            if native.available() and not self.force_py_io:
                 try:
                     native.recv_exact(self._fd, buf, n)
                 except PeerClosed as e:
@@ -417,7 +423,7 @@ class Conn:
         nbytes = _HDR.size + len(meta) + arr.nbytes
         t0 = time.perf_counter()
         try:
-            if native.available():
+            if native.available() and not self.force_py_io:
                 # zero-copy: numpy buffer goes straight into the writev
                 native.send_tensor_frame(self._fd, ord("T"), meta, arr)
                 self.bytes_sent += nbytes
@@ -874,7 +880,8 @@ def _dial_failure_reason(e: OSError) -> str:
 
 def connect(host: str, port: int, retries: int = 60,
             retry_interval: float = 0.25,
-            max_interval: float = 5.0) -> Conn:
+            max_interval: float = 5.0,
+            deadline_s: float | None = None) -> Conn:
     """Client-side connect with retry — the reference launch scripts start
     server and clients concurrently, so clients must tolerate a not-yet-
     listening server (examples/AsyncEASGD.sh backgrounds everything).
@@ -883,12 +890,29 @@ def connect(host: str, port: int, retries: int = 60,
     jitter (sleep ~ U[0, min(max_interval, retry_interval * 2**k)]): a
     whole fleet failing over to a standby otherwise re-dials in
     lockstep and thundering-herds the freshly promoted center.
+
+    ``deadline_s`` bounds the WHOLE retry walk in wall-clock seconds:
+    each dial is capped to the remaining budget and no sleep outlives
+    it.  Without it, ``retries=60`` against a blackholed host can pin a
+    ``failover()`` dial for minutes before the next center is tried.
     """
     last: Exception | None = None
+    deadline = (None if deadline_s is None
+                else time.monotonic() + float(deadline_s))
     for attempt in range(retries):
+        remaining = None
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 and attempt:
+                break
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
+            if remaining is not None:
+                # bound the dial itself too: a SYN into a partition
+                # otherwise blocks for the kernel's connect timeout
+                s.settimeout(max(0.01, remaining))
             s.connect((host, port))
+            s.settimeout(None)
             return Conn(s)
         except OSError as e:
             # Close the failed socket before sleeping: each refused dial
@@ -901,5 +925,11 @@ def connect(host: str, port: int, retries: int = 60,
                         labels=("reason",)).labels(
                             reason=_dial_failure_reason(e)).inc()
             cap = min(max_interval, retry_interval * (2.0 ** attempt))
-            time.sleep(random.uniform(0.0, cap))
+            sleep = random.uniform(0.0, cap)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                sleep = min(sleep, remaining)
+            time.sleep(sleep)
     raise ConnectionError(f"could not connect to {host}:{port}: {last}")

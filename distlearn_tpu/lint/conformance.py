@@ -62,6 +62,10 @@ TAG_BINDINGS: dict = {
     "Rejoin": ("const", "REJOIN"),
     "Shard?": ("const", "SHARD_Q"),
     "Replay": ("const", "REPLAY_Q"),
+    "Join?": ("const", "JOIN_Q"),
+    "Join": ("const", "JOIN"),
+    "Leave?": ("const", "LEAVE_Q"),
+    "Leave": ("const", "LEAVE"),
     "ack": ("const_ci", "ACK"),
     "stale": ("key", "stale"),
     "center": ("stream", "per-leaf center tensor leg (send_tensors)"),
@@ -86,6 +90,9 @@ _CALLSITE_EVIDENCE = (
      "the rejoin center-stream ack leg (schedules' 'ack' after 'center')"),
     ("_replay_exchange", "REPLAY_Q",
      "the replay announcement (schedules' 'Replay' op)"),
+    ("leave", "LEAVE_Q",
+     "the graceful-leave announcement (the join/leave schedules' "
+     "'Leave?' op)"),
 )
 
 

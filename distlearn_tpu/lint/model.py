@@ -42,6 +42,15 @@ Shipped models (:func:`builtin_models`):
   disconnect in every order; every eviction path must return the slot
   AND its pages to the engine (``mutate_serve(finish_on_evict=False)``
   is the seeded DL304).
+* ``membership``      — elastic join/leave/rebalance
+  (``_handle_join``/``_handle_leave``/``_delta_weight``): a joiner is
+  registered only AFTER it adopts the current center (the join fence —
+  ``membership_model(join_fence=False)`` is the seeded DL302), a
+  graceful leave waits out the leaver's in-flight apply before reading
+  the ledger (``leave_flush=False`` races the leave replay against the
+  worker and double-applies, the seeded DL303), and every membership
+  change renormalizes the capacity weights so the fleet's total weight
+  mass is conserved (``renorm=False`` is the seeded DL304).
 
 State spaces are deliberately tiny (1 client, 2 stripes, 2 requests,
 small budgets) so the exhaustive sweep stays well under a second of
@@ -61,7 +70,7 @@ from distlearn_tpu.lint.core import Finding
 __all__ = [
     "ModelSpec", "ModelReport", "check_model", "builtin_models",
     "sync_model", "sharded_model", "replay_model", "failover_model",
-    "serve_model", "lint_models",
+    "serve_model", "membership_model", "lint_models",
 ]
 
 State = Hashable
@@ -637,12 +646,198 @@ def serve_model(*, finish_on_evict: bool = True, slots: int = 2,
 
 
 # ---------------------------------------------------------------------------
+# Elastic membership: join fence (DL302), leave flush (DL303), weight
+# renormalization (DL304).
+
+def membership_model(*, join_fence: bool = True, leave_flush: bool = True,
+                     renorm: bool = True) -> ModelSpec:
+    """Elastic join/leave under every interleaving of a member's last
+    in-flight delta, a joiner's handshake, and a graceful leave.
+
+    Two participants: founding member M (weight 2 — the whole mass of a
+    ``num_nodes=2`` normalization budget) and joiner J.  M may push one
+    delta (seq 1) whose server-side apply is IN FLIGHT — the worker
+    thread holds it — and may then leave gracefully; the delta may also
+    be LOST to a connection cut before the apply lands, which is what
+    makes the leave-replay path (``need=[1]``) real.  J joins, adopts
+    the center, and pushes a delta of its own.
+
+    The three guards under test, each with a seeded mutation:
+
+    * ``join_fence``  — J is registered as a member (deltas accepted)
+      only AFTER it acked adoption of the streamed center
+      (``_handle_join`` calls ``_register_member`` after ``_expect(new,
+      ACK)``).  ``join_fence=False`` registers J at the Join? receipt,
+      so the server can apply a delta from a client that never adopted
+      the center — DL302.  Note J's adopted center legitimately going
+      stale later (M's delta lands after J adopted) is NOT a violation;
+      that is ordinary EASGD staleness.
+    * ``leave_flush`` — ``_handle_leave`` calls ``_wait_cid_idle``
+      before reading the applied-seq ledger.  ``leave_flush=False``
+      reads the ledger while M's apply is still in flight: the ledger
+      says seq 1 never landed, the leave replay applies it, and the
+      worker's apply lands too — the delta counts twice, DL303.  (The
+      ledger is monotonic-max bookkeeping; workers do NOT consult it
+      before applying, so the wait is the only guard.)
+    * ``renorm``      — every membership change recomputes
+      ``_delta_weight`` denominators so live capacity weights sum to
+      the fixed budget.  ``renorm=False`` hands J its raw weight
+      without renormalizing the fleet (sum 4 != 2) — DL304.
+
+    State: ``(j_phase, j_member, j_base, j_pushed, m_phase, m_seq,
+    m_inflight, m_led, m_cnt, center_v, w_m, w_j, stale)``.
+    """
+    BUDGET = 2  # total weight mass: num_nodes x capacity 1.0
+
+    # j_phase: "out" | "joining" | "member";  j_base: center version J
+    # adopted, -1 = never adopted.  m_phase: "idle" | "leaving" |
+    # "flush" | "gone".
+    init = ("out", False, -1, False, "idle", 0, False, 0, 0, 0,
+            BUDGET, 0, False)
+
+    def _renorm_weights(m_alive: bool, j_member: bool) -> "tuple[int, int]":
+        live = int(m_alive) + int(j_member)
+        share = BUDGET // live if live else 0
+        return (share if m_alive else 0, share if j_member else 0)
+
+    def actions(state):
+        (jp, jm, jb, jpu, mp, mseq, minf, mled, mcnt, cv,
+         wm, wj, stale) = state
+        m_alive = mp != "gone"
+        acts = []
+
+        # --- joiner J -----------------------------------------------------
+        if jp == "out":
+            if join_fence:
+                acts.append(("J dials Join?; server assigns cid, streams "
+                             "center (registration deferred to ACK)",
+                             ("joining", jm, jb, jpu, mp, mseq, minf, mled,
+                              mcnt, cv, wm, wj, stale)))
+            else:
+                nwm, nwj = ((wm if m_alive else 0, BUDGET)
+                            if not renorm else
+                            _renorm_weights(m_alive, True))
+                acts.append(("J dials Join?; server REGISTERS J before the "
+                             "center adoption ACK (join fence dropped)",
+                             ("joining", True, jb, jpu, mp, mseq, minf, mled,
+                              mcnt, cv, nwm, nwj, stale)))
+        elif jp == "joining":
+            if join_fence:
+                if renorm:
+                    nwm, nwj = _renorm_weights(m_alive, True)
+                else:
+                    nwm, nwj = (wm if m_alive else 0), BUDGET
+                lab = (f"J ACKs center adoption (version {cv}); server "
+                       "registers J"
+                       + ("" if renorm
+                          else " at RAW weight (renormalization dropped)"))
+                acts.append((lab,
+                             ("member", True, cv, jpu, mp, mseq, minf, mled,
+                              mcnt, cv, nwm, nwj, stale)))
+            else:
+                acts.append((f"J ACKs center adoption (version {cv})",
+                             ("member", jm, cv, jpu, mp, mseq, minf, mled,
+                              mcnt, cv, wm, wj, stale)))
+        if jm and not jpu:
+            nstale = stale or jb < 0
+            lab = ("server worker applies J's delta"
+                   + (" — J NEVER ADOPTED the center" if jb < 0 else
+                      f" (J's base: center version {jb})"))
+            acts.append((lab,
+                         (jp, jm, jb, True, mp, mseq, minf, mled, mcnt,
+                          cv + 1, wm, wj, nstale)))
+
+        # --- member M -----------------------------------------------------
+        if mp == "idle" and mseq == 0:
+            acts.append(("M pushes delta seq 1; server worker now holds "
+                         "it in flight",
+                         (jp, jm, jb, jpu, mp, 1, True, mled, mcnt, cv,
+                          wm, wj, stale)))
+        if minf:
+            acts.append(("server worker applies M's in-flight delta "
+                         "seq 1; ledger records 1",
+                         (jp, jm, jb, jpu, mp, mseq, False, 1, mcnt + 1,
+                          cv + 1, wm, wj, stale)))
+            acts.append(("fault: M's conn cut before the apply — the "
+                         "in-flight delta is lost, ledger unchanged",
+                         (jp, jm, jb, jpu, mp, mseq, False, mled, mcnt,
+                          cv, wm, wj, stale)))
+        if mp == "idle":
+            acts.append(("M sends Leave? claiming seq "
+                         f"{mseq}", (jp, jm, jb, jpu, "leaving", mseq, minf,
+                                     mled, mcnt, cv, wm, wj, stale)))
+        elif mp == "leaving":
+            if not minf or not leave_flush:
+                need = mled < mseq
+                if need:
+                    lab = ("server reads ledger (applied "
+                           f"{mled} < claimed {mseq}) -> need=[1]"
+                           + ("" if not minf else
+                              " while M's apply is STILL IN FLIGHT "
+                              "(leave flush dropped)"))
+                    acts.append((lab,
+                                 (jp, jm, jb, jpu, "flush", mseq, minf,
+                                  mled, mcnt, cv, wm, wj, stale)))
+                else:
+                    nwm, nwj = _renorm_weights(False, jm)
+                    acts.append(("server reads ledger (nothing owed), "
+                                 "removes M, renormalizes survivors",
+                                 (jp, jm, jb, jpu, "gone", mseq, minf,
+                                  mled, mcnt, cv, nwm,
+                                  nwj if jm else wj, stale)))
+            # else: _wait_cid_idle blocks the leave until the worker or
+            # the fault clears the in-flight apply (both enabled above).
+        elif mp == "flush":
+            nwm, nwj = _renorm_weights(False, jm)
+            acts.append(("leave replay applies seq 1; server removes M, "
+                         "renormalizes survivors",
+                         (jp, jm, jb, jpu, "gone", mseq, minf, 1,
+                          mcnt + 1, cv + 1, nwm,
+                          nwj if jm else wj, stale)))
+        return acts
+
+    def invariant(state):
+        (jp, jm, jb, jpu, mp, _mseq, _minf, _mled, mcnt, _cv,
+         wm, wj, stale) = state
+        out = []
+        if stale:
+            out.append((
+                "DL302",
+                "the server applied a delta from a joiner that never "
+                "adopted the center — the join fence (register only "
+                "after the adoption ACK) is missing"))
+        if mcnt > 1:
+            out.append((
+                "DL303",
+                f"M's delta seq 1 applied {mcnt} times — the graceful "
+                "leave read the applied-seq ledger without waiting out "
+                "the in-flight apply, so the leave replay and the "
+                "worker both landed it"))
+        live = ([wm] if mp != "gone" else []) + ([wj] if jm else [])
+        if live and sum(live) != BUDGET:
+            out.append((
+                "DL304",
+                f"live capacity weights sum to {sum(live)}, not the "
+                f"fleet budget {BUDGET} — a membership change skipped "
+                "the weight renormalization and the elastic average is "
+                "biased"))
+        return out
+
+    def is_terminal(state):
+        (jp, jm, _jb, jpu, mp, _mseq, minf, _mled, _mcnt, _cv,
+         _wm, _wj, _stale) = state
+        return mp == "gone" and jp == "member" and jpu and not minf
+
+    return ModelSpec("membership", init, actions, invariant, is_terminal)
+
+
+# ---------------------------------------------------------------------------
 # Repo-facing entries.
 
 def builtin_models() -> list[ModelSpec]:
     """The shipped models in their faithful (unmutated) configuration."""
     return [sync_model(), sharded_model(), replay_model(),
-            failover_model(), serve_model()]
+            failover_model(), serve_model(), membership_model()]
 
 
 def lint_models() -> "list[tuple[ModelReport, ModelSpec]]":

@@ -43,6 +43,7 @@ __all__ = [
     "async_ea_sync_schedule", "async_ea_sharded_schedule",
     "async_ea_rejoin_sharded_schedule", "async_ea_failover_schedule",
     "async_ea_promote_rejoin_schedule", "async_ea_stale_epoch_schedule",
+    "async_ea_join_schedule", "async_ea_leave_schedule",
     "check_schedules", "lock_order_audit",
 ]
 
@@ -328,6 +329,39 @@ def async_ea_promote_rejoin_schedule(num_clients: int = 3) -> dict:
     for i in range(1, k + 1):
         sched[f"C{i}"] = _rejoin_replay_client("S", 1)
     return sched
+
+
+def async_ea_join_schedule() -> dict:
+    """Elastic admission (``AsyncEAClient.join`` / ``_handle_join``): the
+    joiner announces ``Join?`` on the broadcast channel, the server
+    replies with the assigned cid + ephemeral dedicated port, streams the
+    FULL center down the fresh dedicated channel, and the adoption ack
+    coming back is the join fence — ``_register_member`` runs only after
+    it lands.  Strict: the handshake must drain with no timeout crutch."""
+    server = [recv_any("Join?"), send("C", "Join"),
+              send("C", "center"), recv("C", "ack")]
+    client = [send("S", "Join?"), recv("S", "Join"),
+              recv("S", "center"), send("S", "ack")]
+    return {"S": server, "C": client}
+
+
+def async_ea_leave_schedule(num_stripes: int = 1) -> dict:
+    """Graceful departure (``AsyncEAClient.leave`` / ``_handle_leave``):
+    the leaver announces ``Leave?`` with its last pushed seq, the server
+    waits the cid idle, reads the applied-seq ledger and replies with
+    what it is still owed; the leaver replays the un-applied stripe
+    payloads and the final ack releases it.  Strict — the flush must
+    drain without the eviction timeout firing."""
+    n = max(1, int(num_stripes))
+    server = ([recv_any("Leave?"), send("C", "Leave"),
+               recv("C", "Replay")]
+              + [recv("C", "replay_p")] * n
+              + [send("C", "ack")])
+    client = ([send("S", "Leave?"), recv("S", "Leave"),
+               send("S", "Replay")]
+              + [send("S", "replay_p")] * n
+              + [recv("S", "ack")])
+    return {"S": server, "C": client}
 
 
 def async_ea_stale_epoch_schedule() -> dict:
@@ -633,6 +667,12 @@ def lint_comm_protocols(*, num_nodes: int = 7) -> list[Finding]:
                                 name="async_ea.promote-rejoin-herd")
     findings += check_schedules(async_ea_stale_epoch_schedule(),
                                 name="async_ea.stale-epoch-refusal")
+    # elastic membership: join admission and the graceful-leave flush,
+    # both strict by construction
+    findings += check_schedules(async_ea_join_schedule(),
+                                name="async_ea.join")
+    findings += check_schedules(async_ea_leave_schedule(2),
+                                name="async_ea.leave-flush")
     from distlearn_tpu.comm import ring, transport, tree
     from distlearn_tpu.parallel import async_ea
     findings += lock_order_audit([transport, tree, ring, async_ea],

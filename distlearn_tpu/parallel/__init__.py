@@ -5,7 +5,8 @@ from distlearn_tpu.parallel.allreduce_sgd import AllReduceSGD
 from distlearn_tpu.parallel.allreduce_ea import AllReduceEA
 from distlearn_tpu.parallel.async_ea import (AsyncEAClient, AsyncEAServer,
                                              AsyncEAServerConcurrent,
-                                             AsyncEATester, StaleCenterError)
+                                             AsyncEATester, StaleCenterError,
+                                             adaptive_tau_bounds)
 from distlearn_tpu.parallel.ha import (StandbyCenter, install_signal_flush,
                                        promote, restore_center)
 from distlearn_tpu.parallel.sequence import (ring_attention, local_attention,
@@ -27,6 +28,7 @@ __all__ = [
     "AsyncEAClient",
     "AsyncEATester",
     "StaleCenterError",
+    "adaptive_tau_bounds",
     "StandbyCenter",
     "install_signal_flush",
     "promote",

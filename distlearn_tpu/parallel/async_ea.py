@@ -56,6 +56,10 @@ TEST_Q = "Test?"
 ACK = "Ack"
 SHARD_Q = "Shard?"
 REPLAY_Q = "Replay"
+JOIN_Q = "Join?"
+JOIN = "Join"
+LEAVE_Q = "Leave?"
+LEAVE = "Leave"
 
 #: Shard-negotiation schema version (the "shard" key in Enter?/Rejoin?).
 SHARD_V = 1
@@ -64,6 +68,22 @@ SHARD_V = 1
 #: when a restored checkpoint's per-stripe seq table cannot be matched to
 #: the current stripe plan (replay degrades to at-most-once, never twice).
 _SEQ_INF = 2 ** 62
+
+#: α·τ stability product ceiling the straggler-adaptive τ respects
+#: (docs/EA_CONVERGENCE.md: the measured guidance is α = 0.9/τ, i.e. the
+#: elastic fixed point destabilizes as α·τ walks past ~1).
+ALPHA_TAU_PRODUCT = 0.9
+
+
+def adaptive_tau_bounds(tau: int, alpha: float) -> tuple[int, int]:
+    """``[lo, hi]`` bounds for the straggler-adaptive sync period: never
+    below the configured τ (a straggler syncs LESS often, not more) and
+    never past ``ALPHA_TAU_PRODUCT / α`` — stretching τ without shrinking
+    α walks the α·τ stability product toward divergence, so the stretch
+    is capped where the product the fleet was tuned for still holds."""
+    lo = max(1, int(tau))
+    hi = max(lo, int(ALPHA_TAU_PRODUCT / alpha)) if alpha > 0 else lo
+    return lo, hi
 
 
 class StaleCenterError(ProtocolError):
@@ -181,11 +201,15 @@ class _ShardEndpoint:
     """
 
     def __init__(self, host: str, port: int, shard: int, num_nodes: int,
-                 throttle_bps: float | None = None):
+                 throttle_bps: float | None = None, is_member=None):
         import threading
         self.shard = shard
         self.num_nodes = num_nodes
         self.throttle_bps = throttle_bps
+        # membership predicate for hello validation: elastic servers pass
+        # their live roster (joined cids run past num_nodes); the default
+        # keeps the historical fixed-fleet range check
+        self._is_member = is_member or (lambda c: 1 <= c <= num_nodes)
         self.server = Server(host, port)
         # Several stripe workers poll this listener concurrently;
         # Server.accept's settimeout dance is not thread-safe (one
@@ -221,7 +245,7 @@ class _ShardEndpoint:
                 if isinstance(hello, dict) else -1
             if (not isinstance(hello, dict) or hello.get("q") != SHARD_Q
                     or hello.get("shard") != self.shard
-                    or not 1 <= cid <= self.num_nodes):
+                    or cid < 1 or not self._is_member(cid)):
                 raise ProtocolError(f"bad shard hello {hello!r}")
         except (TimeoutError, ConnectionError, ProtocolError, OSError,
                 ValueError):
@@ -335,9 +359,27 @@ class AsyncEAServer:
     def __init__(self, host: str, port: int, num_nodes: int,
                  with_tester: bool = False, accept_timeout: float = 120.0,
                  handshake_timeout: float | None = 30.0, shards: int = 1,
-                 throttle_bps: float | None = None, standby: bool = False):
+                 throttle_bps: float | None = None, standby: bool = False,
+                 elastic: bool = False):
         import threading
         self.num_nodes = num_nodes
+        self._host = host
+        # Elastic membership (ROADMAP item 4): when on, the server keeps
+        # accepting broadcast dials and admits NEW clients through the
+        # Join? handshake (cids past num_nodes, ephemeral dedicated
+        # ports) and retires them through Leave? — the fleet is a live
+        # roster, not a construction-time constant.
+        self.elastic = bool(elastic)
+        # Live roster: every admitted cid (initial fleet + joiners, minus
+        # leavers).  Ids are NEVER reused — the exactly-once ledger and
+        # the concurrent server's generation counters stay unambiguous.
+        self.members: set[int] = set(range(1, num_nodes + 1))
+        self._next_cid = num_nodes + 1
+        # per-client capacity weight advertised at Join?/Enter? (default
+        # 1.0) — folded into every delta apply as
+        # ``w_i = cap_i * num_nodes / Σ_live cap_j`` so a grown fleet
+        # does not multiply the effective α (docs/ELASTIC.md)
+        self._capacity: dict[int, float] = {}
         self.shards = max(1, int(shards))
         # emulated-link pacing applied to every conn this server accepts
         # (bench/chip-free harnesses; None = full loopback speed)
@@ -359,9 +401,11 @@ class AsyncEAServer:
         self._rejoin_pending: list = []
         # Broadcast channel: all clients connect here (EASGD_server.lua:67-68).
         self.broadcast = Server(host, port)
-        # Dedicated per-client channels on port+i (EASGD_server.lua:71-77).
-        self.dedicated_servers = [Server(host, port + i + 1)
-                                  for i in range(num_nodes)]
+        # Dedicated per-client channels, keyed by cid: the initial fleet
+        # on the reference's fixed ports port+i (EASGD_server.lua:71-77);
+        # joiners get ephemeral listeners advertised in the Join reply.
+        self.dedicated_servers: dict[int, Server] = {
+            i + 1: Server(host, port + i + 1) for i in range(num_nodes)}
         # Test channel on port+numNodes+1 (EASGD_server.lua:69-70).
         self.test_server = Server(host, port + num_nodes + 1) \
             if with_tester else None
@@ -371,7 +415,8 @@ class AsyncEAServer:
         # leaf list); extra endpoints just never get advertised.
         self.shard_endpoints = [
             _ShardEndpoint(host, port + num_nodes + 2 + i, i + 1, num_nodes,
-                           throttle_bps=throttle_bps)
+                           throttle_bps=throttle_bps,
+                           is_member=self.members.__contains__)
             for i in range(self.shards - 1)]
         self.stripes: list[tuple[int, int]] | None = None
         # per-leaf split counts + the VIRTUAL leaf list (oversized leaves
@@ -410,19 +455,21 @@ class AsyncEAServer:
             # Warm standby: no fleet to accept — every cid starts evicted,
             # so admission happens exclusively through the rejoin path
             # once this process is promoted (ha.promote / --standby).
-            self.dedicated: list[Conn | None] = [None] * num_nodes
+            self.dedicated: dict[int, Conn | None] = \
+                dict.fromkeys(range(1, num_nodes + 1))
             self.test_conn = None
             self.evicted = set(range(1, num_nodes + 1))
         else:
             self.broadcast.accept(num_nodes, timeout=accept_timeout)
-            self.dedicated = []
-            for s in self.dedicated_servers:
-                self.dedicated.append(s.accept(1, timeout=accept_timeout)[0])
+            self.dedicated = {}
+            for cid in range(1, num_nodes + 1):
+                self.dedicated[cid] = self.dedicated_servers[cid].accept(
+                    1, timeout=accept_timeout)[0]
             self.test_conn = \
                 self.test_server.accept(1, timeout=accept_timeout)[0] \
                 if with_tester else None
             if throttle_bps:
-                for c in (self.broadcast.conns + self.dedicated
+                for c in (self.broadcast.conns + list(self.dedicated.values())
                           + ([self.test_conn] if self.test_conn else [])):
                     c.throttle_bps = throttle_bps
         self.center: list[np.ndarray] | None = None
@@ -437,6 +484,20 @@ class AsyncEAServer:
             "async_ea_evictions_total", "clients evicted mid-handshake")
         self._c_rejoin = obs.counter(
             "async_ea_rejoins_total", "evicted clients re-admitted")
+        self._c_joins = obs.counter(
+            "async_ea_membership_joins_total",
+            "new clients admitted through the Join? handshake")
+        self._c_join_fail = obs.counter(
+            "async_ea_membership_join_failures_total",
+            "Join? handshakes refused or failed mid-adoption")
+        self._c_leaves = obs.counter(
+            "async_ea_membership_leaves_total",
+            "graceful Leave? departures, by pending-delta outcome",
+            labels=("outcome",))
+        self._g_members = obs.gauge(
+            "async_ea_membership_size",
+            "live fleet size (admitted members minus evicted)")
+        self._g_members.set(len(self.members - self.evicted))
         self._c_stale = obs.counter(
             "async_ea_failover_stale_refusals_total",
             "admissions refused on the epoch fence (stale/zombie center)")
@@ -527,6 +588,46 @@ class AsyncEAServer:
                 raise ProtocolError(
                     f"delta leaf dtype {ddtype} != center {dtype} — "
                     "client/server model config skew")
+
+    # -- capacity-weighted elastic averaging (docs/ELASTIC.md) ---------------
+    def _delta_weight(self, cid: int) -> float:
+        """The scale folded into client ``cid``'s delta applies:
+        ``cap_cid * num_nodes / Σ_live cap_j``.  The elastic move's
+        effective pull on the center is ``α · Σ_i w_i`` per round of
+        fleet syncs — normalizing the weights to sum to ``num_nodes``
+        keeps that product at the value the fleet was tuned for while
+        the roster grows or shrinks (a 2× fleet would otherwise double
+        the effective α — docs/EA_CONVERGENCE.md's stability product).
+        Exactly 1.0 for the initial equal-capacity fleet, so fixed-fleet
+        runs stay bitwise identical (the scale multiply is skipped)."""
+        if not self.elastic:
+            return 1.0
+        live = self.members - self.evicted
+        if not live:
+            return 1.0
+        total = sum(self._capacity.get(c, 1.0) for c in live)
+        if total <= 0.0:
+            return 1.0
+        return self._capacity.get(cid, 1.0) * self.num_nodes / total
+
+    def _scale_delta(self, deltas, w: float):
+        """Scale a validated delta by its capacity weight, in place where
+        the buffers allow.  ``w == 1.0`` returns the delta untouched
+        (bitwise fixed-fleet compatibility — and the fused undecoded
+        payload path survives); any other weight decodes a packed
+        payload first, since the wire bytes cannot be rescaled."""
+        if w == 1.0:
+            return deltas
+        if isinstance(deltas, wire.PackedPayload):
+            deltas = deltas.decoded()
+        out = []
+        for d in deltas:
+            d = np.asarray(d)
+            if not d.flags.writeable:
+                d = d.copy()
+            d *= np.asarray(w, d.dtype)
+            out.append(d)
+        return out
 
     def _record_applied(self, cid: int, idx: int, seq: int):
         """Mark stripe ``idx`` of client ``cid``'s sync ``seq`` as applied
@@ -680,6 +781,7 @@ class AsyncEAServer:
         codec = self._wire_cid[cid]
         seq = self._sync_seq.get(cid)
         ha = (cid, seq) if seq is not None else None
+        w = self._delta_weight(cid)
 
         def leg(idx):
             if idx == 0:
@@ -689,8 +791,9 @@ class AsyncEAServer:
                 c = ep.get_conn(cid,
                                 timeout=self.handshake_timeout or 30.0)
                 c.set_timeout(self.handshake_timeout)
-            self._apply_stripe(idx, self._serve_stripe_leg(c, idx, codec),
-                               ha=ha)
+            self._apply_stripe(
+                idx, self._scale_delta(self._serve_stripe_leg(c, idx, codec),
+                                       w), ha=ha)
 
         _fanout([lambda i=i: leg(i) for i in range(len(self.stripes))])
         self._count_sync()
@@ -701,8 +804,9 @@ class AsyncEAServer:
         legs fail fast; remaining clients keep syncing."""
         self.evicted.add(cid)
         self._c_evict.inc()
+        self._g_members.set(len(self.members - self.evicted))
         print_server(f"evicting client #{cid}: {why!r}")
-        conn = self.dedicated[cid - 1]      # None on a never-admitted
+        conn = self.dedicated.get(cid)      # None on a never-admitted
         if conn is not None:                # standby slot
             try:
                 conn.close()
@@ -719,7 +823,7 @@ class AsyncEAServer:
 
     @property
     def live_clients(self) -> int:
-        return self.num_nodes - len(self.evicted)
+        return len(self.members - self.evicted)
 
     # -- re-admission --------------------------------------------------------
     #
@@ -753,7 +857,7 @@ class AsyncEAServer:
                 continue
             kept.append((c, dl))
         self._rejoin_pending = kept
-        if not self.evicted:
+        if not self.evicted and not self.elastic:
             return
         while True:
             r, _, _ = select.select([self.broadcast.sock], [], [], 0.0)
@@ -816,8 +920,9 @@ class AsyncEAServer:
         server overrides to also respawn the client's worker)."""
         self.evicted.discard(cid)
         self._cid_to_broadcast[cid] = idx
-        self.dedicated[cid - 1] = conn
+        self.dedicated[cid] = conn
         self._c_rejoin.inc()
+        self._g_members.set(len(self.members - self.evicted))
 
     def _readmit(self, idx: int, msg) -> None:
         """Complete one ``Rejoin?`` handshake: validate the claimed id is
@@ -832,13 +937,21 @@ class AsyncEAServer:
                                  f"{msg.get('clientID')!r}")
             return
         codec, wire_err = _parse_wire_request(msg)
+        srv = self.dedicated_servers.get(cid)
+        if srv is None:
+            # a joiner whose ephemeral listener is gone (e.g. after a
+            # promotion to a center that never saw it) cannot rejoin by
+            # port — it has to Join? afresh (docs/ELASTIC.md)
+            self._drop_peer(idx, f"dropping rejoin of client #{cid}: "
+                                 "no dedicated listener for that cid")
+            return
         try:
             # SHORT bound: the rejoin protocol dials the dedicated channel
             # BEFORE announcing Rejoin?, so a legit dial is already in the
             # listen backlog — a long wait here would let one half-rejoin
             # (announce without dial) stall serving for every live client
             # by handshake_timeout per attempt.
-            new = self.dedicated_servers[cid - 1].accept(
+            new = srv.accept(
                 1, timeout=min(self.handshake_timeout or 2.0, 2.0))[0]
         except (TimeoutError, OSError) as e:
             print_server(f"rejoin of client #{cid} failed at dedicated "
@@ -929,12 +1042,14 @@ class AsyncEAServer:
         if not hdr.get("abort"):
             dl = (None if self.handshake_timeout is None
                   else time.monotonic() + self.handshake_timeout)
+            w = self._delta_weight(cid)
             for i in need:
                 lo, hi = self.stripes[i]
                 deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
                 self._check_delta(deltas,
                                   center=self._stripe_center(lo, hi))
-                self._apply_stripe(i, deltas, ha=(cid, seq))
+                self._apply_stripe(i, self._scale_delta(deltas, w),
+                                   ha=(cid, seq))
             self._count_sync()
         conn.send_msg(ACK)
 
@@ -946,7 +1061,7 @@ class AsyncEAServer:
             cid = int(msg.get("clientID", -1))
         except (TypeError, ValueError):
             return -1
-        return cid if 1 <= cid <= self.num_nodes else -1
+        return cid if cid >= 1 and cid in self.members else -1
 
     def _drop_peer(self, idx: int, why: str):
         """Close one broadcast conn and log why (bad request/id)."""
@@ -979,6 +1094,11 @@ class AsyncEAServer:
             self._reject_wire(cid, wire_err)
             return None
         self._wire_cid[cid] = codec
+        # capacity refresh: a client may (re-)advertise its weight on any
+        # admission; absent means "keep whatever the roster has" (1.0)
+        cap = msg.get("capacity")
+        if isinstance(cap, (int, float)) and cap > 0:
+            self._capacity[cid] = float(cap)
         # sharding requires the packed wire AND a multi-stripe plan; a
         # client that advertised against an unsharded server (or without
         # a codec) just gets no "shard" key back and stays single-stripe
@@ -997,12 +1117,13 @@ class AsyncEAServer:
         waiting for Enter — it raises ProtocolError on the error reply)
         and evict.  Silently falling back would ship fp32 to a client
         that asked for compression; silently proceeding would corrupt."""
-        conn = self.dedicated[cid - 1]
-        try:
-            conn.set_timeout(self.handshake_timeout)
-            conn.send_msg({"a": ENTER, "wire": {"error": err}})
-        except (TimeoutError, ConnectionError, OSError):
-            pass
+        conn = self.dedicated.get(cid)
+        if conn is not None:
+            try:
+                conn.set_timeout(self.handshake_timeout)
+                conn.send_msg({"a": ENTER, "wire": {"error": err}})
+            except (TimeoutError, ConnectionError, OSError):
+                pass
         self._evict(cid, ProtocolError(err))
 
     def _refuse_stale(self, cid: int, claimed: int):
@@ -1014,7 +1135,7 @@ class AsyncEAServer:
         self._c_stale.inc()
         err = (f"center epoch {self.epoch} is stale: client #{cid} has "
                f"synced with epoch {claimed}")
-        conn = self.dedicated[cid - 1]
+        conn = self.dedicated.get(cid)
         if conn is not None:
             try:
                 conn.set_timeout(self.handshake_timeout)
@@ -1023,6 +1144,181 @@ class AsyncEAServer:
             except (TimeoutError, ConnectionError, OSError):
                 pass
         self._evict(cid, ProtocolError(err))
+
+    # -- elastic membership (Join?/Leave?, docs/ELASTIC.md) ------------------
+    def _handle_join(self, idx: int, msg) -> None:
+        """Admit a NEW client (``Join?``).  The joiner has no cid and no
+        dedicated channel yet: assign the next monotonic cid (never
+        reused), open an ephemeral dedicated listener and advertise its
+        port in the reply, then run the rejoin-shaped center adoption
+        (center down, Ack up).  Registration happens only AFTER the Ack
+        lands — the join fence: a cid that never adopted the current
+        center can never be admitted to push a delta (the membership
+        model in lint/model.py checks exactly this, DL302)."""
+        conn_b = self.broadcast.conns[idx]
+        if not self.elastic or self.center is None:
+            self._c_join_fail.inc()
+            self._drop_peer(idx, "dropping Join?: server is "
+                            + ("not serving yet" if self.elastic
+                               else "not elastic"))
+            return
+        codec, wire_err = _parse_wire_request(msg)
+        if wire_err is not None:
+            self._c_join_fail.inc()
+            try:
+                conn_b.set_timeout(self.handshake_timeout)
+                conn_b.send_msg({"a": JOIN, "wire": {"error": wire_err}})
+            except (TimeoutError, ConnectionError, OSError):
+                pass
+            self._drop_peer(idx, f"dropping joiner: {wire_err}")
+            return
+        cap = msg.get("capacity")
+        cap = float(cap) if isinstance(cap, (int, float)) and cap > 0 else 1.0
+        cid = self._next_cid
+        ded = Server(self._host, 0)     # ephemeral port, advertised below
+        try:
+            with obs.span("async_ea.join", cid=cid):
+                reply: dict[str, Any] = {"a": JOIN, "clientID": cid,
+                                         "port": ded.port,
+                                         "epoch": self.epoch}
+                if codec is not None:
+                    reply["wire"] = {"v": wire.WIRE_V, "codec": codec}
+                conn_b.set_timeout(self.handshake_timeout)
+                conn_b.send_msg(reply)
+                conn_b.set_timeout(None)
+                new = ded.accept(1, timeout=self.handshake_timeout or 30.0)[0]
+                if self.throttle_bps:
+                    new.throttle_bps = self.throttle_bps
+                new.set_timeout(self.handshake_timeout)
+                new.send_tensors(self._rejoin_center(), codec=codec or "raw",
+                                 packed=codec is not None)
+                _expect(new, ACK)
+                new.set_timeout(None)
+        except (TimeoutError, ConnectionError, ProtocolError, OSError,
+                ValueError) as e:
+            self._c_join_fail.inc()
+            ded.close()
+            print_server(f"join of client #{cid} failed mid-handshake: "
+                         f"{e!r}")
+            try:
+                conn_b.close()
+            except OSError:
+                pass
+            return
+        self._next_cid = cid + 1
+        sharded = (isinstance(msg.get("shard"), dict) and codec is not None
+                   and self._shard_spec is not None)
+        self._register_member(cid, idx, new, ded, capacity=cap,
+                              codec=codec, sharded=sharded)
+        print_server(f"client #{cid} joined (capacity {cap:g}, fleet "
+                     f"size {self.live_clients})")
+
+    def _register_member(self, cid: int, idx: int, conn: Conn,
+                         ded: Server, *, capacity: float,
+                         codec: str | None, sharded: bool) -> None:
+        """Install a joiner into the roster — the concurrent server
+        overrides to also create its token queue and spawn its workers
+        under the dispatcher lock."""
+        self.members.add(cid)
+        self._capacity[cid] = capacity
+        self.dedicated_servers[cid] = ded
+        self.dedicated[cid] = conn
+        self._cid_to_broadcast[cid] = idx
+        self._wire_cid[cid] = codec
+        self._shard_cid[cid] = sharded
+        self._c_joins.inc()
+        self._g_members.set(len(self.members - self.evicted))
+
+    def _handle_leave(self, idx: int, msg) -> None:
+        """Graceful departure (``Leave?``): flush the leaver's newest
+        delta through the exactly-once ledger — the reply names the
+        stripes whose applied-seq is behind the claimed seq and the
+        client replays exactly those encoded bytes — then retire the
+        cid: channels and listener closed, roster entry and capacity
+        dropped.  The weight renormalization is implicit: weights derive
+        from the live roster (``_delta_weight``), so the survivors'
+        shares grow the moment the leaver is gone."""
+        cid = self._parse_cid(msg)
+        if cid < 0:
+            self._drop_peer(idx, f"dropping leave with bad clientID "
+                                 f"{msg.get('clientID')!r}")
+            return
+        if cid in self.evicted:
+            # nothing can be in flight and the dedicated channel is gone:
+            # the pending delta (if any) is unreachable — dropped, the
+            # stale-update loss EASGD already tolerates
+            self._c_leaves.labels(outcome="dropped").inc()
+            self._remove_member(cid)
+            print_server(f"client #{cid} left (was evicted; "
+                         "pending delta dropped)")
+            return
+        # let any in-flight legs of the leaver's LAST sync settle before
+        # reading the ledger — replaying a stripe a worker is still
+        # applying would double-apply it (concurrent server override)
+        self._wait_cid_idle(cid, self.handshake_timeout or 30.0)
+        conn = self.dedicated.get(cid)
+        claimed = msg.get("seq")
+        need: list[int] = []
+        if (isinstance(claimed, int) and claimed > 0
+                and self.stripes is not None):
+            seqs = self._applied_seq.get(cid) or [0] * len(self.stripes)
+            need = [i for i, s in enumerate(seqs) if s < claimed]
+        outcome = "flushed" if need else "clean"
+        if conn is None:
+            outcome = "dropped"
+        else:
+            try:
+                with obs.span("async_ea.leave", cid=cid):
+                    conn.set_timeout(self.handshake_timeout)
+                    conn.send_msg({"a": LEAVE,
+                                   "replay": {"seq": claimed, "need": need}})
+                    if need and isinstance(claimed, int):
+                        self._recv_replay(cid, conn, claimed, need)
+                    conn.set_timeout(None)
+            except (TimeoutError, ConnectionError, ProtocolError, OSError,
+                    ValueError) as e:
+                outcome = "dropped"
+                print_server(f"leave flush of client #{cid} failed: {e!r} "
+                             "(pending delta dropped)")
+        self._c_leaves.labels(outcome=outcome).inc()
+        self._remove_member(cid)
+        print_server(f"client #{cid} left ({outcome}; fleet size "
+                     f"{self.live_clients})")
+
+    def _wait_cid_idle(self, cid: int, timeout: float) -> bool:
+        """Block until none of ``cid``'s sync legs are in flight.  The
+        serial server IS the only serving thread, so nothing can be in
+        flight while it sits here."""
+        return True
+
+    def _remove_member(self, cid: int) -> None:
+        """Retire a cid for good: close every channel AND its dedicated
+        listener, then drop the roster entry.  Unlike an eviction the
+        cid cannot come back — ids are never reused, a departed client
+        re-enters through a fresh Join?."""
+        conn = self.dedicated.pop(cid, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for ep in self.shard_endpoints:
+            ep.drop(cid)
+        idx = self._cid_to_broadcast.pop(cid, None)
+        if idx is not None:
+            try:
+                self.broadcast.conns[idx].close()
+            except OSError:
+                pass
+        srv = self.dedicated_servers.pop(cid, None)
+        if srv is not None:
+            srv.close()
+        self.members.discard(cid)
+        self.evicted.discard(cid)
+        for table in (self._capacity, self._wire_cid, self._shard_cid,
+                      self._sync_seq, self._applied_seq):
+            table.pop(cid, None)
+        self._g_members.set(len(self.members - self.evicted))
 
     def sync_server(self, params: PyTree,
                     timeout: float | None = None) -> PyTree:
@@ -1050,10 +1346,10 @@ class AsyncEAServer:
         while True:
             self._accept_rejoiners()
             if deadline is None:
-                slice_t = 0.5 if self.evicted else None
+                slice_t = 0.5 if (self.evicted or self.elastic) else None
             else:
                 slice_t = max(0.0, deadline - time.monotonic())
-                if self.evicted:
+                if self.evicted or self.elastic:
                     slice_t = min(slice_t, 0.5)
             # serverEnterSync (lua :163-177): critical section — one client.
             try:
@@ -1069,8 +1365,10 @@ class AsyncEAServer:
                 # is the documented "fleet finished" stop condition —
                 # re-raise.  A (promoted) standby STARTS with zero conns
                 # and every cid evicted: its whole fleet arrives through
-                # Rejoin? dials, so keep polling _accept_rejoiners.
-                if not (self._standby and self.evicted):
+                # Rejoin? dials, so keep polling _accept_rejoiners.  An
+                # ELASTIC server's next client may likewise arrive on the
+                # listening socket (Join?) at any time — keep polling.
+                if not ((self._standby and self.evicted) or self.elastic):
                     raise
                 if deadline is not None and time.monotonic() >= deadline:
                     raise TimeoutError(
@@ -1082,11 +1380,17 @@ class AsyncEAServer:
             if isinstance(msg, dict) and msg.get("q") == REJOIN_Q:
                 self._readmit(idx, msg)
                 continue
+            if isinstance(msg, dict) and msg.get("q") == JOIN_Q:
+                self._handle_join(idx, msg)
+                continue
+            if isinstance(msg, dict) and msg.get("q") == LEAVE_Q:
+                self._handle_leave(idx, msg)
+                continue
             cid = self._admit(idx, msg)
             if cid is None:
                 continue
             self.current_client = cid
-            conn = self.dedicated[cid - 1]  # 1-based ids (ref)
+            conn = self.dedicated[cid]      # 1-based ids (ref)
             t0 = time.perf_counter() if self._obs_on else 0.0
             codec = self._wire_cid.get(cid)
             deltas = None
@@ -1141,6 +1445,7 @@ class AsyncEAServer:
                 self._h_handshake.observe(time.perf_counter() - t0)
             if deltas is not None:
                 seq = self._sync_seq.get(cid)
+                deltas = self._scale_delta(deltas, self._delta_weight(cid))
                 self._apply_delta(
                     deltas, ha=(cid, seq) if seq is not None else None)
             print_server(f"received delta from client #{self.current_client}")
@@ -1217,7 +1522,9 @@ class AsyncEAServer:
                                 for c, s in self._applied_seq.items()},
                 "wire": {str(c): v for c, v in self._wire_cid.items()},
                 "shards": self.shards,
-                "num_nodes": self.num_nodes}
+                "num_nodes": self.num_nodes,
+                "members": sorted(self.members),
+                "capacity": {str(c): v for c, v in self._capacity.items()}}
         return self.syncs_completed, leaves, meta
 
     def _checkpoint_locked(self):
@@ -1288,7 +1595,10 @@ class AsyncEAServer:
                 cid = int(key)
             except (TypeError, ValueError):
                 continue
-            if not 1 <= cid <= self.num_nodes:
+            if cid not in self.members:
+                # a joiner cid from the dead center: its ephemeral
+                # dedicated listener is gone, so it cannot rejoin here —
+                # it re-enters through a fresh Join? (docs/ELASTIC.md)
                 continue
             if (isinstance(val, list) and len(val) == n
                     and all(isinstance(v, int) for v in val)):
@@ -1309,7 +1619,7 @@ class AsyncEAServer:
             except Exception as e:  # noqa: BLE001 — close never raises
                 print_server(f"final checkpoint flush failed: {e!r}")
         self.broadcast.close()
-        for s in self.dedicated_servers:
+        for s in self.dedicated_servers.values():
             s.close()
         for ep in self.shard_endpoints:
             ep.close()
@@ -1349,12 +1659,12 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                  handshake_timeout: float | None = 30.0,
                  pin_device=None, rejoin_grace: float = 10.0,
                  shards: int = 1, throttle_bps: float | None = None,
-                 standby: bool = False):
+                 standby: bool = False, elastic: bool = False):
         super().__init__(host, port, num_nodes, with_tester=with_tester,
                          accept_timeout=accept_timeout,
                          handshake_timeout=handshake_timeout,
                          shards=shards, throttle_bps=throttle_bps,
-                         standby=standby)
+                         standby=standby, elastic=elastic)
         # How long the dispatcher keeps polling for a Rejoin? after every
         # broadcast conn has closed WHILE somebody is evicted — bounded so
         # a permanently-dead evictee cannot hold up shutdown/drained.
@@ -1367,7 +1677,10 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         # behind an O(P) apply — they grab the current immutable center
         # list under self._lock in O(1)
         self._apply_lock = threading.Lock()
-        self._queues = [queue.Queue() for _ in range(num_nodes)]
+        # per-cid token queues (growable: a Join? adds an entry under
+        # self._lock, a Leave? pops it after sentinelling the worker out)
+        self._queues: dict[int, Any] = {
+            cid: queue.Queue() for cid in range(1, num_nodes + 1)}
         # (cid, stripe) -> token queue for the stripe workers (stripes
         # 1..S-1; stripe 0 rides the main worker), filled in start()
         self._shard_queues: dict[tuple[int, int], Any] = {}
@@ -1381,12 +1694,18 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         # queue tokens carry the generation they were issued against and
         # workers discard mismatches — a token from before an evict/rejoin
         # cycle must never drive a handshake on the fresh connection
-        self._conn_gen = [0] * num_nodes
+        self._conn_gen: dict[int, int] = {
+            cid: 0 for cid in range(1, num_nodes + 1)}
         self._threads: list = []
         self._workers: dict[int, Any] = {}
         self._stop = threading.Event()
         self._dispatch_closed = threading.Event()
         self._inflight = 0
+        # per-cid slice of _inflight (same lock holds): the Leave? flush
+        # must wait out the leaver's in-flight legs before reading the
+        # ledger, or the replay would double-apply a stripe a worker is
+        # still applying
+        self._inflight_cid: dict[int, int] = {}
         self._sync_count = 0
         self._device = pin_device
         self._dev_center = None
@@ -1696,7 +2015,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             return False
         with self._lock:
             inflight = self._inflight
-        return (inflight == 0 and all(q.empty() for q in self._queues)
+        return (inflight == 0
+                and all(q.empty() for q in self._queues.values())
                 and all(q.empty() for q in self._shard_queues.values()))
 
     def current_center(self, params: PyTree) -> PyTree:
@@ -1737,9 +2057,10 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         if cid in self.evicted:
             return
         import queue as _q
-        self._conn_gen[cid - 1] += 1    # stale tokens die at the worker
+        self._conn_gen[cid] = self._conn_gen.get(cid, 0) + 1
+        #                               ^ stale tokens die at the worker
         super()._evict(cid, why)
-        for q in ([self._queues[cid - 1]]
+        for q in ([q for q in (self._queues.get(cid),) if q is not None]
                   + [sq for (qcid, _), sq in self._shard_queues.items()
                      if qcid == cid]):
             while True:
@@ -1748,8 +2069,26 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 except _q.Empty:
                     break
                 if token is not None:     # the None stop sentinel never
-                    self._inflight -= 1   # incremented _inflight
-                    self._g_inflight.dec()
+                    self._dec_inflight_locked(cid)  # incremented _inflight
+
+    def _dec_inflight_locked(self, cid: int, n: int = 1):
+        """Settle ``n`` of ``cid``'s in-flight leg slots; caller holds
+        ``self._lock`` (the per-cid table and the global count must move
+        together — ``_wait_cid_idle`` reads both)."""
+        self._inflight -= n
+        self._g_inflight.dec(n)
+        left = self._inflight_cid.get(cid, 0) - n
+        if left > 0:
+            self._inflight_cid[cid] = left
+        else:
+            self._inflight_cid.pop(cid, None)
+
+    def _delta_weight(self, cid: int) -> float:
+        # workers read the membership set concurrently with dispatcher
+        # join/leave mutations — snapshot under the lock (no recursion:
+        # every caller applies deltas unlocked)
+        with self._lock:
+            return super()._delta_weight(cid)
 
     # -- threads -------------------------------------------------------------
     def _health(self) -> dict:
@@ -1775,10 +2114,10 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         self._workers = {
             cid: threading.Thread(target=self._worker, args=(cid,),
                                   daemon=True)
-            for cid in range(1, self.num_nodes + 1)}
+            for cid in sorted(self.members)}
         self._threads += list(self._workers.values())
         if self.stripes is not None and len(self.stripes) > 1:
-            for cid in range(1, self.num_nodes + 1):
+            for cid in sorted(self.members):
                 for idx in range(1, len(self.stripes)):
                     self._shard_queues[(cid, idx)] = queue.Queue()
                     self._threads.append(threading.Thread(
@@ -1799,7 +2138,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         surviving count is published on ``async_ea_server_threads`` so the
         soak can assert it returns to zero."""
         self._stop.set()
-        for q in self._queues:
+        for q in list(self._queues.values()):
             q.put(None)
         for q in self._shard_queues.values():
             q.put(None)
@@ -1826,6 +2165,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 if self._inflight:
                     self._g_inflight.dec(self._inflight)
                     self._inflight = 0
+                self._inflight_cid.clear()
         self._g_threads.set(len(self._threads))
         obs.set_health_source(None)
 
@@ -1868,7 +2208,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             # fresh connection, fresh generation: tokens issued against
             # the pre-eviction conn still in flight anywhere must not
             # drive a handshake on this one
-            self._conn_gen[cid - 1] += 1
+            self._conn_gen[cid] = self._conn_gen.get(cid, 0) + 1
             super()._finish_readmit(cid, idx, conn)
             # a worker that self-evicted DEREGISTERED itself in the same
             # lock hold as its eviction, so presence here means parked
@@ -1884,6 +2224,81 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                                  if th.is_alive()] + [t]
         if need:
             t.start()
+
+    # -- elastic membership (concurrent overrides) ---------------------------
+    def _register_member(self, cid: int, idx: int, conn: Conn,
+                         ded: Server, *, capacity: float,
+                         codec: str | None, sharded: bool) -> None:
+        """Roster install + the joiner's serving threads: token queue,
+        generation slot, main worker, and (striped) one shard queue +
+        worker per stripe — all created under the dispatcher lock so an
+        Enter? racing the join either sees the whole kit or none of it."""
+        import queue
+        import threading
+        with self._lock:
+            super()._register_member(cid, idx, conn, ded,
+                                     capacity=capacity, codec=codec,
+                                     sharded=sharded)
+            self._conn_gen.setdefault(cid, 0)
+            self._queues[cid] = queue.Queue()
+            t = threading.Thread(target=self._worker, args=(cid,),
+                                 daemon=True)
+            self._workers[cid] = t
+            spawn = [t]
+            if self.stripes is not None and len(self.stripes) > 1:
+                for s in range(1, len(self.stripes)):
+                    self._shard_queues[(cid, s)] = queue.Queue()
+                    spawn.append(threading.Thread(
+                        target=self._shard_worker, args=(cid, s),
+                        daemon=True))
+            # drop exited threads while appending (same hygiene as the
+            # rejoin respawn): churn must not grow this list forever
+            self._threads = [th for th in self._threads
+                             if th.is_alive()] + spawn
+        for t in spawn:
+            t.start()
+        self._g_threads.set(len(self._threads))
+
+    def _remove_member(self, cid: int) -> None:
+        """Retire the cid AND its serving threads: bump the generation
+        (stale tokens die), drain + sentinel its queues so the parked
+        workers exit, and pop the per-cid state — all under the
+        dispatcher lock, so nothing can enqueue into a dying queue."""
+        import queue as _q
+        with self._lock:
+            self._conn_gen[cid] = self._conn_gen.get(cid, 0) + 1
+            qs = [q for q in (self._queues.pop(cid, None),)
+                  if q is not None]
+            for key in [k for k in self._shard_queues if k[0] == cid]:
+                qs.append(self._shard_queues.pop(key))
+            for q in qs:
+                while True:
+                    try:
+                        token = q.get_nowait()
+                    except _q.Empty:
+                        break
+                    if token is not None:
+                        self._dec_inflight_locked(cid)
+                q.put(None)         # unpark + retire the worker
+            self._workers.pop(cid, None)
+            self._conn_gen.pop(cid, None)
+            super()._remove_member(cid)
+
+    def _wait_cid_idle(self, cid: int, timeout: float) -> bool:
+        """Wait out the cid's in-flight legs (bounded).  New tokens for
+        this cid cannot land meanwhile — the dispatcher is the only
+        enqueuer and it is the thread sitting here."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                q = self._queues.get(cid)
+                idle = (self._inflight_cid.get(cid, 0) == 0
+                        and (q is None or q.empty()))
+            if idle:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
 
     def _dispatch_loop(self):
         while not self._stop.is_set():
@@ -1906,6 +2321,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # channels — returning on the instantaneous empty
                 # ``evicted`` would kill dispatch moments before that
                 # worker's eviction lands, making rejoin impossible.
+                if self.elastic:
+                    # an elastic fleet legitimately drains to zero (all
+                    # left) and grows again: keep polling the listener
+                    # for the next Join?/Rejoin? until stopped
+                    self._accept_rejoiners()
+                    time.sleep(0.05)
+                    continue
                 deadline = time.monotonic() + (self.handshake_timeout
                                                or 30.0)
                 while time.monotonic() < deadline and not self.evicted:
@@ -1930,6 +2352,14 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # bounded (handshake_timeout) center push is acceptable
                 self._readmit(idx, msg)
                 continue
+            if isinstance(msg, dict) and msg.get("q") == JOIN_Q:
+                # same rarity argument as rejoin: the join adoption is
+                # one bounded center push on the dispatcher thread
+                self._handle_join(idx, msg)
+                continue
+            if isinstance(msg, dict) and msg.get("q") == LEAVE_Q:
+                self._handle_leave(idx, msg)
+                continue
             cid = self._admit(idx, msg)
             if cid is None:
                 continue
@@ -1939,15 +2369,20 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # unlocked check — enqueueing now would leak the token
                 if cid in self.evicted:
                     continue
+                q = self._queues.get(cid)
+                if q is None:
+                    continue            # left between _admit and here
                 # tokens carry the connection generation they were issued
                 # against; every leg settles its own _inflight slot
-                gen = self._conn_gen[cid - 1]
+                gen = self._conn_gen.get(cid, 0)
                 sharded = (self._shard_cid.get(cid, False)
                            and bool(self._shard_queues))
                 n_legs = len(self.stripes) if sharded else 1
                 self._inflight += n_legs
                 self._g_inflight.inc(n_legs)
-                self._queues[cid - 1].put(gen)
+                self._inflight_cid[cid] = \
+                    self._inflight_cid.get(cid, 0) + n_legs
+                q.put(gen)
                 if sharded:
                     for idx in range(1, len(self.stripes)):
                         self._shard_queues[(cid, idx)].put(gen)
@@ -1955,8 +2390,13 @@ class AsyncEAServerConcurrent(AsyncEAServer):
     def _worker(self, cid: int):
         bufs = None     # reusable delta recv buffers (host path): no 100 MB
         #                 allocation + page-fault pass per sync
+        # the queue is captured once: a graceful leave pops the dict entry
+        # and sentinels THIS queue, so the parked thread still drains it
+        q = self._queues.get(cid)
+        if q is None:
+            return
         while not self._stop.is_set():
-            token = self._queues[cid - 1].get()
+            token = q.get()
             if token is None:
                 return
             # re-read per token: a rejoin swaps the dedicated conn while
@@ -1965,8 +2405,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             # same lock hold so conn/codec/sharded are all from the same
             # connection epoch as the token.
             with self._lock:
-                stale = token != self._conn_gen[cid - 1]
-                conn = self.dedicated[cid - 1]
+                stale = token != self._conn_gen.get(cid, 0)
+                conn = self.dedicated.get(cid)
                 codec = self._wire_cid.get(cid)
                 sharded = self._shard_cid.get(cid, False)
                 # the claimed seq rides the same hold as conn/codec, so it
@@ -1974,9 +2414,10 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # admission overwriting _sync_seq cannot skew this sync's
                 # ledger entry
                 seq = self._sync_seq.get(cid)
+                if conn is None:
+                    stale = True
                 if stale:
-                    self._inflight -= 1
-                    self._g_inflight.dec()
+                    self._dec_inflight_locked(cid)
             if stale:
                 continue
             t0 = time.perf_counter() if self._obs_on else 0.0
@@ -2045,7 +2486,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     # exit would see is_alive()==True and skip the
                     # respawn, stranding the client's tokens forever.
                     with self._lock:
-                        current = self.dedicated[cid - 1] is conn
+                        current = self.dedicated.get(cid) is conn
                         if current:
                             self._evict_locked(cid, e)  # drains queue too
                             self._workers.pop(cid, None)
@@ -2055,6 +2496,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 if self._obs_on:
                     self._h_handshake.observe(time.perf_counter() - t0)
                 ha = (cid, seq) if seq is not None else None
+                deltas = self._scale_delta(deltas, self._delta_weight(cid))
                 if sharded:
                     self._apply_stripe(0, deltas, ha=ha)
                     self._count_sync()
@@ -2063,8 +2505,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 self._maybe_checkpoint()
             finally:
                 with self._lock:
-                    self._inflight -= 1
-                    self._g_inflight.dec()
+                    self._dec_inflight_locked(cid)
 
     def _shard_worker(self, cid: int, idx: int):
         """Serve stripe ``idx`` (>= 1) of one client's syncs, forever.
@@ -2075,12 +2516,17 @@ class AsyncEAServerConcurrent(AsyncEAServer):
         thread simply parks for the client's next admission.  That keeps
         the rejoin path free of (num_shards - 1) respawn bookkeeping."""
         ep = self.shard_endpoints[idx - 1]
+        # captured once, like _worker: a graceful leave pops the dict entry
+        # and sentinels this queue so the parked thread retires itself
+        q0 = self._shard_queues.get((cid, idx))
+        if q0 is None:
+            return
         while not self._stop.is_set():
-            token = self._shard_queues[(cid, idx)].get()
+            token = q0.get()
             if token is None:
                 return
             with self._lock:
-                stale = token != self._conn_gen[cid - 1]
+                stale = token != self._conn_gen.get(cid, 0)
                 codec = self._wire_cid.get(cid)
                 seq = self._sync_seq.get(cid)   # same hold: same admission
             try:
@@ -2091,7 +2537,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                     conn = ep.get_conn(cid,
                                        timeout=self.handshake_timeout or 30.0)
                     with self._lock:
-                        superseded = token != self._conn_gen[cid - 1]
+                        superseded = token != self._conn_gen.get(cid, 0)
                     if superseded:
                         # superseded while we waited for the dial (an
                         # eviction raced past us): don't serve or judge
@@ -2103,8 +2549,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                         # one by one), park as a reaper, polling until
                         # it dies, is superseded by a fresh dial, or the
                         # next admission's token takes over.
-                        q = self._shard_queues[(cid, idx)]
-                        while (not self._stop.is_set() and q.empty()
+                        while (not self._stop.is_set() and q0.empty()
                                and ep.conns.get(cid) is conn):
                             if ep.drop_if_dead(cid, conn):
                                 break
@@ -2131,16 +2576,17 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                                       and ep.conns.get(cid) is conn)
                         if registered:
                             ep.drop(cid)
-                        if (token == self._conn_gen[cid - 1]
+                        if (token == self._conn_gen.get(cid, 0)
                                 and (conn is None or registered)):
                             self._evict_locked(cid, e)
                     continue
-                self._apply_stripe(idx, deltas,
+                self._apply_stripe(idx,
+                                   self._scale_delta(deltas,
+                                                     self._delta_weight(cid)),
                                    ha=(cid, seq) if seq is not None else None)
             finally:
                 with self._lock:
-                    self._inflight -= 1
-                    self._g_inflight.dec()
+                    self._dec_inflight_locked(cid)
 
 
 class _DeltaSender:
@@ -2227,16 +2673,32 @@ class AsyncEAClient:
                  alpha: float, codec: str | None = "raw",
                  overlap: bool = False, sharded: bool = True,
                  throttle_bps: float | None = None,
-                 centers: list[tuple[str, int]] | None = None):
+                 centers: list[tuple[str, int]] | None = None,
+                 capacity: float = 1.0, adaptive_tau: bool = False,
+                 _broadcast: Conn | None = None,
+                 _dedicated_port: int | None = None):
         if node < 1:
             raise ValueError("node is 1-based (reference convention)")
         if codec is not None and codec not in wire.CODECS:
             raise ValueError(f"unknown wire codec {codec!r} "
                              f"(supported: {', '.join(wire.CODECS)})")
+        if capacity <= 0:
+            raise ValueError("capacity must be > 0")
         self.node = node
         self.tau = int(tau)
         self.alpha = float(alpha)
         self.codec = codec
+        self.capacity = float(capacity)
+        # straggler-adaptive τ (docs/ELASTIC.md): stretch the sync period
+        # from the observed sync-latency EWMA, never past the α·τ
+        # stability product (docs/EA_CONVERGENCE.md) — a slow client syncs
+        # less often instead of queueing behind the fleet
+        self.adaptive_tau = bool(adaptive_tau)
+        self._tau_lo, self._tau_hi = adaptive_tau_bounds(tau, alpha)
+        self.tau_effective = self._tau_lo
+        self._next_sync = self._tau_lo
+        self._lat_ewma: float | None = None
+        self._lat_floor: float | None = None
         # sharded=True merely ADVERTISES the capability (alongside the wire
         # codec); the server decides whether to stripe.  False pins the
         # single-channel sync even against a sharded server.
@@ -2245,9 +2707,14 @@ class AsyncEAClient:
         self.step = 0
         self.host, self.port = host, port
         # clientBroadcast -> port; dedicated client -> port+node
-        # (EASGD_client.lua:58-61).
-        self.broadcast = connect(host, port)
-        self.conn = connect(host, port + node)
+        # (EASGD_client.lua:58-61).  A joiner's dedicated channel lives on
+        # the ephemeral port the Join reply advertised instead (join()
+        # also hands over the already-dialed broadcast conn).
+        self._ded_port = _dedicated_port
+        self.broadcast = (_broadcast if _broadcast is not None
+                          else connect(host, port))
+        self.conn = connect(host, port + node if _dedicated_port is None
+                            else _dedicated_port)
         if throttle_bps:
             self.conn.throttle_bps = throttle_bps
         self.center: list[np.ndarray] | None = None
@@ -2303,6 +2770,10 @@ class AsyncEAClient:
         self._c_stale = obs.counter(
             "async_ea_failover_stale_refusals_total",
             "admissions refused on the epoch fence (stale/zombie center)")
+        self._g_tau = obs.gauge(
+            "async_ea_adaptive_tau",
+            "effective sync period after straggler adaptation, by client",
+            labels=("cid",))
 
     def _announce(self, q: str, want: str) -> bool:
         """Send an admission request (with the wire advertisement unless a
@@ -2314,6 +2785,11 @@ class AsyncEAClient:
             msg["wire"] = {"v": wire.WIRE_V, "codec": self.codec}
             if self.sharded:
                 msg["shard"] = {"v": SHARD_V}
+            if self.capacity != 1.0:
+                # capacity-weighted EA (docs/ELASTIC.md): an extra key a
+                # legacy server never looks at; an elastic one folds it
+                # into the delta weight on every admission
+                msg["capacity"] = self.capacity
             # epoch fence (docs/HA.md): announce the newest epoch we've
             # synced against so a demoted/zombie center refuses us loudly
             # instead of serving state the fleet has moved past
@@ -2435,8 +2911,15 @@ class AsyncEAClient:
         """Every ``tau``-th call: full sync handshake (ref ``syncClient``,
         lua :134-146).  Returns ``(new_params, synced)``."""
         self.step += 1
-        if self.step % self.tau != 0:   # isSyncNeeded (lua :47-57)
+        if self.adaptive_tau:
+            # due-step counter instead of exact modulus: tau_effective
+            # may change between syncs, so "every τ-th step" becomes
+            # "τ_eff steps after the last sync"
+            if self.step < self._next_sync:
+                return params, False
+        elif self.step % self.tau != 0:     # isSyncNeeded (lua :47-57)
             return params, False
+        t_sync = time.perf_counter() if self.adaptive_tau else 0.0
 
         if self._sender is not None:
             # previous round's delta must be fully on the wire before the
@@ -2544,8 +3027,29 @@ class AsyncEAClient:
             self._sender.submit(_push_delta)
         else:
             _push_delta()
+        if self.adaptive_tau:
+            self._note_sync_latency(time.perf_counter() - t_sync)
+            self._next_sync = self.step + self.tau_effective
         print_client(self.node, "synced")
         return _rebuild(params, new_leaves), True
+
+    def _note_sync_latency(self, dt: float) -> None:
+        """Fold one sync's wall time into the latency EWMA and re-derive
+        ``tau_effective``: the stretch factor is the EWMA over the best
+        latency ever observed (the un-contended floor), so a straggling
+        client syncs proportionally less often — bounded above by the
+        α·τ stability product (``adaptive_tau_bounds``)."""
+        self._lat_ewma = (dt if self._lat_ewma is None
+                          else 0.7 * self._lat_ewma + 0.3 * dt)
+        self._lat_floor = (self._lat_ewma if self._lat_floor is None
+                           else min(self._lat_floor, self._lat_ewma))
+        ratio = (self._lat_ewma / self._lat_floor
+                 if self._lat_floor and self._lat_floor > 0 else 1.0)
+        self.tau_effective = min(self._tau_hi,
+                                 max(self._tau_lo,
+                                     int(round(self._tau_lo * ratio))))
+        if self._obs_on:
+            self._g_tau.labels(cid=self.node).set(self.tau_effective)
 
     def _encode_stripe(self, deltas: list[np.ndarray],
                        residuals: list[np.ndarray] | None,
@@ -2628,7 +3132,14 @@ class AsyncEAClient:
         # handshake by accepting on port+node and must find us dialed in
         self.broadcast = connect(self.host, self.port, retries=retries,
                                  retry_interval=retry_interval)
-        self.conn = connect(self.host, self.port + self.node,
+        # a joiner's dedicated channel is the ephemeral listener the Join
+        # reply advertised — it survives evictions (only _remove_member
+        # closes it), so rejoin works against the SAME center; a promoted
+        # standby never heard of it, so a joiner failing over re-enters
+        # through a fresh join() instead (docs/ELASTIC.md)
+        self.conn = connect(self.host,
+                            self.port + self.node if self._ded_port is None
+                            else self._ded_port,
                             retries=retries, retry_interval=retry_interval)
         if self.throttle_bps:
             self.conn.throttle_bps = self.throttle_bps
@@ -2761,6 +3272,102 @@ class AsyncEAClient:
         raise ConnectionError(
             f"client {self.node}: no center admitted us "
             f"(dial list: {self._centers!r})")
+
+    @classmethod
+    def join(cls, host: str, port: int, params: PyTree, tau: int,
+             alpha: float, *, capacity: float = 1.0,
+             codec: str | None = "raw", overlap: bool = False,
+             sharded: bool = True, adaptive_tau: bool = False,
+             throttle_bps: float | None = None,
+             centers: list[tuple[str, int]] | None = None,
+             timeout: float | None = 60.0
+             ) -> tuple["AsyncEAClient", PyTree]:
+        """Enter a RUNNING elastic fleet: announce ``Join?`` on the
+        broadcast port (no cid — the server assigns the next monotonic
+        one and opens an ephemeral dedicated listener for us), dial the
+        advertised port, adopt the current center, and Ack — only then
+        does the server count us a member (the join fence).  Returns
+        ``(client, params)`` with params := center, ready for
+        :meth:`sync_client`."""
+        b = connect(host, port)
+        try:
+            b.set_timeout(timeout)
+            msg: dict[str, Any] = {"q": JOIN_Q, "capacity": float(capacity)}
+            if codec is not None:
+                msg["wire"] = {"v": wire.WIRE_V, "codec": codec}
+                if sharded:
+                    msg["shard"] = {"v": SHARD_V}
+            b.send_msg(msg)
+            reply = b.recv_msg()
+            if not (isinstance(reply, dict) and reply.get("a") == JOIN):
+                raise ProtocolError(
+                    f"protocol desync: expected {JOIN!r} reply, "
+                    f"got {reply!r}")
+            w = reply.get("wire")
+            if isinstance(w, dict) and w.get("error"):
+                raise ProtocolError(str(w["error"]))
+            cid, dport = reply.get("clientID"), reply.get("port")
+            if not (isinstance(cid, int) and isinstance(dport, int)):
+                raise ProtocolError(f"malformed {JOIN!r} reply {reply!r}")
+            b.set_timeout(None)
+        except BaseException:
+            b.close()
+            raise
+        cl = cls(host, port, cid, tau, alpha, codec=codec, overlap=overlap,
+                 sharded=sharded, throttle_bps=throttle_bps,
+                 centers=centers, capacity=capacity,
+                 adaptive_tau=adaptive_tau, _broadcast=b,
+                 _dedicated_port=dport)
+        try:
+            ep = reply.get("epoch")
+            if isinstance(ep, int):
+                cl._seen_epoch = ep
+            # the join reply echoing the wire advertisement plays the role
+            # of the Enter reply in _announce: packed wire is negotiated
+            cl._packed = isinstance(w, dict)
+            leaves = _leaves(params)
+            cl.conn.set_timeout(timeout)
+            cl.center = cl.conn.recv_tensors(n=len(leaves))
+            cl.conn.send_msg(ACK)
+            cl.conn.set_timeout(None)
+        except BaseException:
+            cl.close()
+            raise
+        print_client(cid, "joined the fleet")
+        return cl, _rebuild(params, [c.copy() for c in cl.center])
+
+    def leave(self, timeout: float | None = 30.0) -> None:
+        """Depart gracefully: flush any overlapped send, announce
+        ``Leave?`` with the seq of our newest delta, and run the replay
+        exchange for whatever stripes the center's ledger is missing —
+        the leaver's last contribution lands exactly once instead of
+        being dropped.  Closes every channel on the way out (even when
+        the flush fails — the lost delta is the staleness EASGD already
+        tolerates)."""
+        try:
+            if self._sender is not None:
+                try:
+                    self._sender.flush()
+                except (TimeoutError, ConnectionError, ProtocolError,
+                        OSError, ValueError):
+                    pass        # conn may be dead; Leave? will say so too
+            with obs.span("async_ea.leave", cid=self.node):
+                self.broadcast.set_timeout(timeout)
+                self.conn.set_timeout(timeout)
+                self.broadcast.send_msg({"q": LEAVE_Q,
+                                         "clientID": self.node,
+                                         "seq": self._seq})
+                reply = self.conn.recv_msg()
+                if not (isinstance(reply, dict)
+                        and reply.get("a") == LEAVE):
+                    raise ProtocolError(
+                        f"protocol desync: expected {LEAVE!r} reply, "
+                        f"got {reply!r}")
+                self._last_reply = reply
+                self._replay_exchange()
+            print_client(self.node, "left the fleet")
+        finally:
+            self.close()
 
     def close(self):
         if self._sender is not None:

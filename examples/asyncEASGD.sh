@@ -4,6 +4,20 @@
 # and derives the server IP from ifconfig; localhost + fresh port suffices
 # here (multi-host: pass --host/--port to each role).
 cd "$(dirname "$0")"
+# --join-after S / --leave-after S: elastic membership drills
+# (docs/ELASTIC.md).  Either flag switches the server to
+# --concurrent --elastic; the joiner enters mid-run as client 3 through
+# the Join? handshake, the leaver is client 2 departing gracefully via
+# Leave? (pending delta flushed through the ledger, not dropped).
+JOIN_AFTER=${JOIN_AFTER:-}
+LEAVE_AFTER=${LEAVE_AFTER:-}
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --join-after)  JOIN_AFTER=$2; shift 2 ;;
+    --leave-after) LEAVE_AFTER=$2; shift 2 ;;
+    *) echo "usage: $0 [--join-after SECS] [--leave-after SECS]" >&2; exit 2 ;;
+  esac
+done
 PORT=${PORT:-9500}
 NODES=2
 EPOCHS=${EPOCHS:-1}
@@ -22,7 +36,13 @@ common="--numNodes $NODES --port $PORT --numEpochs $EPOCHS --batchSize $BATCH \
   --numExamples $N --communicationTime $TAU --model $MODEL"
 # CONCURRENT=1 serves clients on overlapped worker threads
 # (AsyncEAServerConcurrent) instead of the reference's critical section
+ELASTIC=
+if [ -n "$JOIN_AFTER$LEAVE_AFTER" ]; then
+  CONCURRENT=1   # elastic membership needs the concurrent server
+  ELASTIC=1
+fi
 SERVER_FLAGS=${CONCURRENT:+--concurrent}
+SERVER_FLAGS="$SERVER_FLAGS ${ELASTIC:+--elastic}"
 # SHARDS=N stripes the center across N shard channels (docs/PERF.md);
 # clients negotiate the plan in the Enter? handshake automatically
 SERVER_FLAGS="$SERVER_FLAGS ${SHARDS:+--shards $SHARDS}"
@@ -34,7 +54,16 @@ SERVER_FLAGS="$SERVER_FLAGS ${CENTER_CKPT:+--centerCkpt $CENTER_CKPT}"
 SERVER_FLAGS="$SERVER_FLAGS ${CKPT_EVERY:+--ckptEvery $CKPT_EVERY}"
 CLIENT_FLAGS=${STANDBY_PORT:+--centers 127.0.0.1:$STANDBY_PORT}
 
-python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS $SERVER_FLAGS &
+# Membership drills make the served-sync count dynamic (a leaver serves
+# fewer, a joiner more), so the tester's fixed push cadence cannot be
+# precomputed: skip the eval channel, give the sync budget slack, and
+# let the server stop when the fleet drains (or goes idle).
+if [ -n "$ELASTIC" ]; then
+  SYNCS=$(( SYNCS * 3 ))
+  python easgd_server.py $common --numSyncs $SYNCS --syncTimeout 30 $SERVER_FLAGS &
+else
+  python easgd_server.py $common --tester --testTime $TESTTIME --numSyncs $SYNCS $SERVER_FLAGS &
+fi
 SERVER=$!
 STANDBY=
 if [ -n "$STANDBY_PORT" ] && [ -n "$CENTER_CKPT" ]; then
@@ -57,10 +86,27 @@ if [ -n "$KILL_AFTER_CKPTS" ] && [ -n "$CENTER_CKPT" ]; then
     kill -TERM $SERVER
   ) &
 fi
-python easgd_tester.py $common --numTests $NUMTESTS &
-TESTER=$!
+TESTER=
+if [ -z "$ELASTIC" ]; then
+  python easgd_tester.py $common --numTests $NUMTESTS &
+  TESTER=$!
+fi
 python easgd_client.py $common --nodeIndex 1 --verbose $CLIENT_FLAGS &
 C1=$!
-python easgd_client.py $common --nodeIndex 2 --verbose $CLIENT_FLAGS &
+# the leave drill rides client 2: it trains, announces Leave? after the
+# deadline (flushing its in-flight delta), and exits cleanly
+python easgd_client.py $common --nodeIndex 2 --verbose $CLIENT_FLAGS \
+  ${LEAVE_AFTER:+--leaveAfter $LEAVE_AFTER} &
 C2=$!
-wait $SERVER $TESTER $C1 $C2 $STANDBY
+C3=
+if [ -n "$JOIN_AFTER" ]; then
+  # the join drill: a third client enters the running fleet via Join? —
+  # the server assigns its cid and streams the live center before it
+  # counts as a member (the join fence)
+  ( sleep "$JOIN_AFTER"
+    echo "[drill] client 3 joining the fleet after ${JOIN_AFTER}s"
+    exec python easgd_client.py $common --nodeIndex 3 --joinFleet \
+      --verbose $CLIENT_FLAGS ) &
+  C3=$!
+fi
+wait $SERVER $TESTER $C1 $C2 $C3 $STANDBY

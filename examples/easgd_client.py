@@ -30,6 +30,23 @@ def main():
                         "just port) to fail over to when the primary "
                         "dies for good (docs/HA.md); with --autoRejoin, "
                         "a dead rejoin falls back to walking this list"),
+        "joinFleet": (False, "enter a RUNNING --elastic server through "
+                             "the Join? handshake instead of the founding "
+                             "Enter? admission: the server assigns the "
+                             "cid and this client adopts the live center "
+                             "before training (docs/ELASTIC.md)"),
+        "leaveAfter": (0.0, "seconds of training after which this client "
+                            "departs gracefully via Leave? — the pending "
+                            "delta is flushed through the server's "
+                            "ledger, not dropped (0 = train to the end)"),
+        "capacity": (1.0, "advertised capacity weight: an elastic server "
+                          "scales this client's deltas by "
+                          "cap*N/sum(live caps) so heterogeneous fleets "
+                          "keep the fixed-fleet alpha budget"),
+        "adaptiveTau": (False, "straggler adaptation: stretch the "
+                               "effective tau (bounded by alpha*tau<=0.9) "
+                               "when syncs run slower than this client's "
+                               "best-ever pace"),
     })
     setup_platform(1, opt.tpu)
     obs_http = obs_setup(opt)
@@ -45,8 +62,11 @@ def main():
     from distlearn_tpu.utils.logging import print_client, set_verbose
 
     set_verbose(opt.verbose)
+    # a joiner's nodeIndex may run past the founding fleet (the server
+    # assigns the real cid anyway) — wrap it onto a valid data partition
+    part = (opt.nodeIndex - 1) % opt.numNodes
     model, params, mstate, ds, nc = build_model_and_data(
-        opt, partition=opt.nodeIndex - 1, partitions=opt.numNodes)
+        opt, partition=part, partitions=opt.numNodes)
 
     codec = None if opt.wireCodec == "legacy" else opt.wireCodec
     # --shards 0 opts this client out of striped syncs (it still joins a
@@ -59,12 +79,22 @@ def main():
         if tok:
             h, _, pp = tok.rpartition(":")
             centers.append((h or opt.host, int(pp)))
-    client = AsyncEAClient(opt.host, opt.port, node=opt.nodeIndex,
-                           tau=opt.communicationTime, alpha=opt.alpha,
-                           codec=codec, overlap=opt.overlapSync,
-                           sharded=opt.shards != 0,
-                           centers=centers or None)
-    params = client.init_client(params)
+    if opt.joinFleet:
+        client, params = AsyncEAClient.join(
+            opt.host, opt.port, params, opt.communicationTime, opt.alpha,
+            capacity=opt.capacity, codec=codec, overlap=opt.overlapSync,
+            sharded=opt.shards != 0, adaptive_tau=opt.adaptiveTau,
+            centers=centers or None)
+        opt.nodeIndex = client.node    # the server assigned the real cid
+    else:
+        client = AsyncEAClient(opt.host, opt.port, node=opt.nodeIndex,
+                               tau=opt.communicationTime, alpha=opt.alpha,
+                               codec=codec, overlap=opt.overlapSync,
+                               sharded=opt.shards != 0,
+                               capacity=opt.capacity,
+                               adaptive_tau=opt.adaptiveTau,
+                               centers=centers or None)
+        params = client.init_client(params)
 
     @jax.jit
     def grad_step(p, s, x, y, rng):
@@ -78,11 +108,22 @@ def main():
         return jax.tree_util.tree_map(
             lambda pp, gg: pp - np.float32(opt.learningRate) * gg, p, g)
 
+    import time as _time
     rng = random.PRNGKey(opt.seed + opt.nodeIndex)
     step = 0
+    t0 = _time.monotonic()
+    left = False
     for epoch in range(1, opt.numEpochs + 1):
+        if left:
+            break
         sampler = PermutationSampler(ds.size, seed=opt.seed + epoch)
         for bx, by in batch_iterator(ds, sampler, opt.batchSize):
+            if opt.leaveAfter and _time.monotonic() - t0 >= opt.leaveAfter:
+                print_client(opt.nodeIndex,
+                             f"leave drill: departing after {step} steps")
+                client.leave()
+                left = True
+                break
             rng, sub = random.split(rng)
             grads, mstate, loss = grad_step(params, mstate, bx, by, sub)
             # sync BETWEEN grads and update (EASGD_client.lua:109 then :113)
@@ -120,7 +161,8 @@ def main():
                 print_client(opt.nodeIndex,
                              f"step {step} loss {float(loss):.4f} (synced)")
     print_client(opt.nodeIndex, "done")
-    client.close()
+    if not left:              # leave() already closed every channel
+        client.close()
     obs_finish(opt, obs_http)
 
 

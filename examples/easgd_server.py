@@ -46,6 +46,11 @@ def main():
                              "(host:port or just port) and promote when "
                              "it stops answering, instead of promoting "
                              "on the first checkpoint seen"),
+        "elastic": (False, "admit Join?/Leave? membership changes mid-run "
+                           "(requires --concurrent): joiners adopt the "
+                           "live center through the join fence, leavers "
+                           "flush their pending delta through the ledger "
+                           "before departing (docs/ELASTIC.md)"),
     })
     setup_platform(1, opt.tpu)
     obs_http = obs_setup(opt)
@@ -70,6 +75,8 @@ def main():
     print_server(f"serving {opt.numNodes} clients, {num_syncs} syncs, "
                  f"tester={opt.tester}")
 
+    if opt.elastic and not opt.concurrent:
+        raise SystemExit("--elastic requires --concurrent")
     if opt.standby and not (opt.concurrent and opt.centerCkpt):
         raise SystemExit("--standby requires --concurrent and --centerCkpt")
     if opt.standby and opt.tester:
@@ -82,7 +89,8 @@ def main():
         srv = AsyncEAServerConcurrent(opt.host, opt.port, opt.numNodes,
                                       with_tester=opt.tester,
                                       shards=max(1, opt.shards),
-                                      standby=opt.standby)
+                                      standby=opt.standby,
+                                      elastic=opt.elastic)
         if opt.standby:
             sb = ha.StandbyCenter(srv, opt.centerCkpt, params)
             if opt.watchPrimary:

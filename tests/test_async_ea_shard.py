@@ -251,7 +251,7 @@ def test_mid_stripe_death_cleans_every_shard_and_rejoin_resyncs():
     p = cl.init_client(_params())
     st.join(timeout=30)
     srv = srv_box["srv"]
-    gen0 = srv._conn_gen[0]
+    gen0 = srv._conn_gen[1]
 
     # get admitted (reply pins the stripe plan, shard conns dialed) ...
     assert cl._announce(ENTER_Q, ENTER) is True
@@ -264,7 +264,7 @@ def test_mid_stripe_death_cleans_every_shard_and_rejoin_resyncs():
     while time.monotonic() < deadline and 1 not in srv.evicted:
         time.sleep(0.02)
     assert 1 in srv.evicted
-    assert srv._conn_gen[0] > gen0          # stale tokens can't replay
+    assert srv._conn_gen[1] > gen0          # stale tokens can't replay
     deadline = time.monotonic() + 10
     while time.monotonic() < deadline and any(
             1 in ep.conns for ep in srv.shard_endpoints):
@@ -272,12 +272,12 @@ def test_mid_stripe_death_cleans_every_shard_and_rejoin_resyncs():
     for ep in srv.shard_endpoints:
         assert 1 not in ep.conns            # every shard channel dropped
 
-    gen1 = srv._conn_gen[0]
+    gen1 = srv._conn_gen[1]
     p = cl.rejoin(p)                        # fresh channels, full center
     deadline = time.monotonic() + 10        # readmit finishes server-side
-    while time.monotonic() < deadline and srv._conn_gen[0] <= gen1:
+    while time.monotonic() < deadline and srv._conn_gen[1] <= gen1:
         time.sleep(0.02)
-    assert srv._conn_gen[0] > gen1          # readmit bumps again
+    assert srv._conn_gen[1] > gen1          # readmit bumps again
     assert cl._stripes is not None          # plan re-advertised + re-dialed
     drift = {k: v + 2.0 for k, v in p.items()}
     p2, synced = cl.sync_client(drift)

@@ -71,6 +71,32 @@ def test_cli_parity_smoke():
     assert report["failures"] == [] and report["promotions"] == 1
 
 
+@pytest.mark.elastic
+def test_partition_mid_sync_heal_replays_to_bitwise_parity():
+    """One-way partition injected exactly between a round's parameter
+    math and its delta push: the blackholed delta times the client out
+    of the fleet, the heal lets the failover rejoin through, and the
+    applied-seq ledger replays the lost delta exactly once — center AND
+    client are bitwise identical to the unpartitioned reference."""
+    report = chaos.run_scenario("partition_heal", rounds=12)
+    assert report["failures"] == []          # includes the bitwise diff
+    assert report["dropped_bytes"] > 0       # the delta really blackholed
+    assert report["evictions"] >= 1 and report["rejoins"] >= 1
+
+
+@pytest.mark.elastic
+def test_cli_scenario_smoke():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos.py"),
+         "scenario", "--name", "partition_heal", "--rounds", "8"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr[-800:]
+    report = json.loads(r.stdout[r.stdout.index("{"):])
+    assert report["failures"] == [] and report["rejoins"] >= 1
+
+
 @pytest.mark.slow
 def test_churn_soak_liveness_and_leaks():
     """The soak: three mixed-codec clients each self-kill mid-handshake,

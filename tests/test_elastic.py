@@ -1,0 +1,222 @@
+"""Elastic fleet membership (docs/ELASTIC.md): fast units for the
+comm-layer fault plan's determinism, capacity-weight normalization,
+the straggler-adaptive τ bounds, and the membership protocol model —
+plus the seeded chaos scenarios (tools/chaos.py ``scenario``; the long
+ones also carry ``slow``)."""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import chaos  # noqa: E402
+
+from distlearn_tpu.comm import FaultInjected, FaultPlan  # noqa: E402
+from distlearn_tpu.lint.model import check_model, membership_model  # noqa: E402
+from distlearn_tpu.parallel.async_ea import (  # noqa: E402
+    ALPHA_TAU_PRODUCT, AsyncEAServer, adaptive_tau_bounds)
+
+pytestmark = pytest.mark.elastic
+
+
+# ------------------------------------------------------ fault plan units
+
+def _drive(plan: FaultPlan) -> None:
+    """One fixed mutator/dial sequence — refused dials never touch the
+    network, so the decision log is pure plan state."""
+    plan.partition("a", "send")
+    plan.delay("b", 0.01)
+    plan.bandwidth("b", 1e6)
+    plan.heal("a")
+    plan.fail_dials("a", 2)
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            plan.connect("127.0.0.1", 1, link="a")
+    plan.flaky_dials("a", 1.0)       # p=1: refuses, but draws the RNG
+    with pytest.raises(FaultInjected):
+        plan.connect("127.0.0.1", 1, link="a")
+    plan.cut_after("b", 64)
+
+
+def test_fault_plan_same_seed_same_decisions():
+    p1, p2 = FaultPlan(seed=7), FaultPlan(seed=7)
+    _drive(p1)
+    _drive(p2)
+    assert p1.decisions() == p2.decisions()
+    assert len(p1.decisions()) >= 9
+
+
+def test_fault_plan_per_link_rng_streams_are_independent():
+    """Decisions on one link must not perturb another's RNG stream: a
+    plan that also exercises link 'z' first still refuses the same 'a'
+    dials."""
+    p1, p2 = FaultPlan(seed=7), FaultPlan(seed=7)
+    p2.flaky_dials("z", 1.0)
+    with pytest.raises(FaultInjected):
+        p2.connect("127.0.0.1", 1, link="z")
+    _drive(p1)
+    _drive(p2)
+    a1 = [e for e in p1.decisions() if e[0] == "a"]
+    a2 = [e for e in p2.decisions() if e[0] == "a"]
+    assert a1 == a2
+
+
+# ------------------------------------------- capacity-weight normalization
+
+def _srv(members, capacity=(), num_nodes=2, elastic=True, evicted=()):
+    """The attribute slice ``AsyncEAServer._delta_weight`` reads."""
+    return types.SimpleNamespace(
+        elastic=elastic, members=set(members), evicted=set(evicted),
+        _capacity=dict(capacity), num_nodes=num_nodes)
+
+
+def _w(ns, cid):
+    return AsyncEAServer._delta_weight(ns, cid)
+
+
+def test_initial_equal_capacity_fleet_weighs_exactly_one():
+    ns = _srv({1, 2})
+    assert _w(ns, 1) == 1.0 and _w(ns, 2) == 1.0
+
+
+def test_non_elastic_server_never_scales():
+    ns = _srv({1, 2, 3}, capacity={3: 5.0}, elastic=False)
+    assert _w(ns, 3) == 1.0
+
+
+def test_weights_renormalize_on_join_and_sum_to_budget():
+    # a capacity-2 joiner on a num_nodes=2 fleet: w = cap*N/Σcap
+    ns = _srv({1, 2, 3}, capacity={3: 2.0})
+    assert _w(ns, 1) == pytest.approx(0.5)
+    assert _w(ns, 3) == pytest.approx(1.0)
+    live = ns.members - ns.evicted
+    assert sum(_w(ns, c) for c in live) == pytest.approx(ns.num_nodes)
+
+
+def test_weights_renormalize_on_leave_and_eviction():
+    ns = _srv({1, 2, 3}, capacity={3: 2.0})
+    ns.members.discard(3)            # graceful leave
+    assert _w(ns, 1) == 1.0
+    ns = _srv({1, 2, 3}, capacity={3: 2.0}, evicted={3})
+    assert _w(ns, 1) == 1.0          # evicted drops out of the denominator
+
+
+# --------------------------------------------------- adaptive-τ bounds
+
+def test_adaptive_tau_bounds_values():
+    assert adaptive_tau_bounds(4, 0.05) == (4, 18)
+    assert adaptive_tau_bounds(1, 0.1) == (1, 9)
+    assert adaptive_tau_bounds(2, 0.1) == (2, 9)
+
+
+def test_adaptive_tau_never_shrinks_below_configured_tau():
+    lo, hi = adaptive_tau_bounds(8, 0.5)   # 0.9/α = 1 < τ
+    assert (lo, hi) == (8, 8)
+
+
+def test_adaptive_tau_ceiling_respects_stability_product():
+    for tau in (1, 2, 4):
+        for alpha in (0.02, 0.05, 0.1, 0.3):
+            lo, hi = adaptive_tau_bounds(tau, alpha)
+            assert 1 <= lo <= hi
+            # the stretch ceiling never crosses α·τ ≤ 0.9 unless the
+            # CONFIGURED τ already does (we never shrink below it)
+            assert hi * alpha <= ALPHA_TAU_PRODUCT or hi == lo
+
+
+# ------------------------------------------------- membership model gate
+
+def test_membership_model_clean():
+    rep = check_model(membership_model())
+    assert rep.findings == [] and rep.states > 20
+
+
+@pytest.mark.parametrize("mutation,rule", [
+    ("join_fence", "DL302"), ("leave_flush", "DL303"),
+    ("renorm", "DL304")])
+def test_membership_mutations_each_caught_by_exactly_their_rule(
+        mutation, rule):
+    rep = check_model(membership_model(**{mutation: False}))
+    assert sorted({f.rule for f in rep.findings}) == [rule]
+
+
+# ------------------------------------------- diststat membership table
+
+def _fam(name, value, kind="counter", labels=None, labelnames=()):
+    return {"name": name, "kind": kind, "help": "",
+            "labelnames": list(labelnames),
+            "samples": [{"labels": labels or {}, "value": value}]}
+
+
+def test_diststat_membership_table(tmp_path):
+    import json
+
+    import diststat
+    recs = [
+        {"type": "span", "name": "async_ea.join", "ts": 1.0, "dur": 0.2},
+        {"type": "span", "name": "async_ea.leave", "ts": 1.5, "dur": 0.1},
+        {"type": "snapshot", "ts": 2.0, "metrics": [
+            _fam("async_ea_membership_joins_total", 2),
+            _fam("async_ea_membership_join_failures_total", 1),
+            {"name": "async_ea_membership_leaves_total", "kind": "counter",
+             "help": "", "labelnames": ["outcome"],
+             "samples": [{"labels": {"outcome": "flushed"}, "value": 1},
+                         {"labels": {"outcome": "clean"}, "value": 1}]},
+            _fam("async_ea_membership_size", 2, kind="gauge"),
+            _fam("async_ea_adaptive_tau", 9, kind="gauge",
+                 labels={"cid": "1"}, labelnames=["cid"]),
+        ]},
+    ]
+    log = tmp_path / "run.jsonl"
+    log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    tab = diststat.summarize_run([str(log)])["membership"]
+    assert tab["joins"] == 2 and tab["join_failures"] == 1
+    assert tab["leaves"] == {"clean": 1, "flushed": 1}
+    assert tab["fleet_size"] == 2
+    assert tab["adaptive_tau"] == {"1": 9}
+    assert tab["latency"]["async_ea.join"]["count"] == 1
+
+
+def test_diststat_membership_table_empty_on_fixed_fleet(tmp_path):
+    import json
+
+    import diststat
+    log = tmp_path / "run.jsonl"
+    log.write_text(json.dumps(
+        {"type": "snapshot", "ts": 1.0, "metrics": [
+            _fam("async_ea_syncs_total", 5)]}) + "\n")
+    assert diststat.summarize_run([str(log)])["membership"] == {}
+
+
+# ------------------------------------------------------ chaos scenarios
+
+@pytest.mark.chaos
+def test_scenario_flash_join_doubles_fleet_and_converges():
+    report = chaos.run_scenario("flash_join", rounds=10)
+    assert report["failures"] == []
+    assert report["peak_members"] == 4
+    assert report["dist"] <= report["tol"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_scenario_rolling_leave_returns_to_founding_fleet():
+    report = chaos.run_scenario("rolling_leave", rounds=12)
+    assert report["failures"] == []
+    assert report["peak_members"] == 4 and report["final_members"] == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_scenario_slow_node_stretches_tau_within_bounds():
+    report = chaos.run_scenario("slow_node", rounds=12)
+    assert report["failures"] == []
+    lo, hi = report["tau_bounds"]
+    assert lo < report["tau_slow"] <= hi
+    assert report["tau_fast"] == lo

@@ -16,8 +16,8 @@ import pytest
 
 from distlearn_tpu.lint.model import (ModelSpec, builtin_models, check_model,
                                       failover_model, lint_models,
-                                      replay_model, serve_model,
-                                      sharded_model, sync_model)
+                                      membership_model, replay_model,
+                                      serve_model, sharded_model, sync_model)
 
 pytestmark = pytest.mark.model
 
@@ -31,7 +31,7 @@ def _rules(findings):
 def test_builtin_models_all_clean_and_exhaustive():
     reports = lint_models()
     assert [spec.name for _rep, spec in reports] == [
-        "sync", "sharded", "replay", "failover", "serve"]
+        "sync", "sharded", "replay", "failover", "serve", "membership"]
     for rep, spec in reports:
         assert rep.findings == [], (
             f"{spec.name}: " + "; ".join(map(str, rep.findings)))
@@ -82,6 +82,31 @@ def test_dl302_failover_without_fence_applies_stale_delta():
 def test_dl304_serve_evict_leaking_slot_is_caught():
     rep = check_model(serve_model(finish_on_evict=False))
     assert _rules(rep.findings) == ["DL304"]
+
+
+def test_dl302_membership_without_join_fence_applies_unadopted_delta():
+    """Register the joiner before its center-adoption ACK: the server can
+    apply a delta from a client that never adopted the center."""
+    rep = check_model(membership_model(join_fence=False))
+    assert _rules(rep.findings) == ["DL302"]
+    assert "NEVER ADOPTED" in rep.findings[0].message
+    assert "counterexample" in rep.findings[0].message
+
+
+def test_dl303_membership_without_leave_flush_double_applies():
+    """Read the applied-seq ledger while the leaver's apply is still in
+    flight: the leave replay and the worker both land the delta."""
+    rep = check_model(membership_model(leave_flush=False))
+    assert _rules(rep.findings) == ["DL303"]
+    assert "STILL IN FLIGHT" in rep.findings[0].message
+
+
+def test_dl304_membership_without_renorm_breaks_weight_budget():
+    """Skip the capacity-weight renormalization at join: live weights no
+    longer sum to the fleet budget and the elastic average is biased."""
+    rep = check_model(membership_model(renorm=False))
+    assert _rules(rep.findings) == ["DL304"]
+    assert "budget" in rep.findings[0].message
 
 
 def test_mutated_models_stay_clean_when_unmutated():

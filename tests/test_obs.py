@@ -430,7 +430,7 @@ def test_e2e_concurrent_run_jsonl_trail(clean_obs, tmp_path):
     tf.join(timeout=30)
     tg.join(timeout=30)
     assert out["synced"] and 2 not in srv.evicted
-    conns.extend(srv.dedicated)
+    conns.extend(c for c in srv.dedicated.values() if c is not None)
     conns.extend(srv.broadcast.conns)
     srv.stop()
     srv.close()

@@ -160,9 +160,9 @@ def test_distlint_model_and_races_flags(capsys):
     doc = _json.loads(capsys.readouterr().out)
     assert set(doc) == {"findings", "costs", "info", "units", "errors"}
     assert doc["findings"] == [] and doc["errors"] == 0
-    assert doc["units"] == 7
+    assert doc["units"] == 8
     for unit in ("model:sync", "model:sharded", "model:replay",
-                 "model:failover", "model:serve"):
+                 "model:failover", "model:serve", "model:membership"):
         assert doc["info"][unit]["states"] > 0
         assert doc["info"][unit]["transitions"] > 0
 

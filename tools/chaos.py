@@ -1,10 +1,11 @@
 #!/usr/bin/env python
-"""chaos — kill/promote soak driver for the AsyncEA center HA stack.
+"""chaos — kill/promote and elastic-membership soak driver for AsyncEA.
 
-Two scenarios (docs/HA.md):
+Three entry points (docs/HA.md, docs/ELASTIC.md):
 
-    python tools/chaos.py parity --rounds 16 --kills 5,11 [--mid-flight]
-    python tools/chaos.py churn  --rounds 12 --clients 3 --server-kills 2
+    python tools/chaos.py parity   --rounds 16 --kills 5,11 [--mid-flight]
+    python tools/chaos.py churn    --rounds 12 --clients 3 --server-kills 2
+    python tools/chaos.py scenario --name flash_join --rounds 12 --seed 0
 
 ``parity`` runs one client against a striped concurrent center with
 checkpointing on, kills the center at the requested rounds (either on a
@@ -30,7 +31,30 @@ promotion per center kill, and no fd/thread accumulation — not parity
 (rejoin adopts the current center, deliberately forking the
 trajectory).
 
-Importable: tests/test_chaos.py drives run_parity/run_churn directly.
+``scenario`` is the elastic-fleet chaos driver (docs/ELASTIC.md): four
+named, seeded scenarios over the comm-layer fault-injection plan
+(``comm/faults.py``) and the elastic membership verbs —
+
+* ``flash_join``     — the fleet doubles (2 -> 4 clients) mid-run via
+  ``Join?`` and must still converge to the descent target within
+  tolerance of a fixed 2-client reference run;
+* ``rolling_leave``  — join two (one at double capacity), then leave
+  them one at a time through the graceful ``Leave?`` flush; membership
+  must return to the founding fleet with every leave accounted;
+* ``slow_node``      — a seeded delay is injected on one client's
+  dedicated link AFTER its latency floor is established; its
+  straggler-adaptive τ must stretch above τ_lo (bounded by the α·τ
+  product) while the fleet still converges;
+* ``partition_heal`` — a one-way send partition lands exactly between
+  the sync's param math and the delta push; the server evicts, the
+  link heals, and the rejoin replay must land the blackholed delta
+  EXACTLY once — asserted bitwise against the unkilled reference.
+
+Settle/recovery budgets honor ``DISTLEARN_CHAOS_SETTLE_S`` and
+``DISTLEARN_CHAOS_RECOVER_S`` (seconds) for slow CI machines.
+
+Importable: tests/test_chaos.py and tests/test_elastic.py drive
+run_parity / run_churn / run_scenario directly.
 """
 
 from __future__ import annotations
@@ -50,13 +74,19 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from distlearn_tpu.comm import ProtocolError  # noqa: E402
+from distlearn_tpu.comm import FaultPlan, ProtocolError  # noqa: E402
 from distlearn_tpu.obs import core  # noqa: E402
 from distlearn_tpu.parallel import ha  # noqa: E402
 from distlearn_tpu.parallel.async_ea import (  # noqa: E402
     ENTER, ENTER_Q, AsyncEAClient, AsyncEAServerConcurrent)
 
 _SYNC_ERRORS = (OSError, TimeoutError, ProtocolError)
+
+#: CI-tunable budgets: how long a fleet may take to drain in-flight legs
+#: (settle) and how long a client may take to re-enter after a fault
+#: (recover).  Loaded once at import; override via the environment.
+CHAOS_SETTLE_S = float(os.environ.get("DISTLEARN_CHAOS_SETTLE_S", "30"))
+CHAOS_RECOVER_S = float(os.environ.get("DISTLEARN_CHAOS_RECOVER_S", "120"))
 
 
 def _reserve_window(n: int, host: str = "127.0.0.1") -> int:
@@ -85,13 +115,25 @@ def _reserve_window(n: int, host: str = "127.0.0.1") -> int:
     raise RuntimeError(f"could not reserve a {n}-port window")
 
 
+_SHAPES = (("a", (64, 3)), ("b", (7,)), ("c", (32, 32)),
+           ("d", (5,)), ("e", (128,)), ("f", (2, 2)))
+
+
 def _params() -> dict:
     """Six float32 leaves, ragged shapes (mirrors the shard tests) —
     exercises sub-leaf striping at S=4."""
     rng = np.random.default_rng(0)
     return {k: rng.standard_normal(shape).astype(np.float32)
-            for k, shape in (("a", (64, 3)), ("b", (7,)), ("c", (32, 32)),
-                             ("d", (5,)), ("e", (128,)), ("f", (2, 2)))}
+            for k, shape in _SHAPES}
+
+
+def _target() -> dict:
+    """The descent target for the elastic scenarios — a fixed point every
+    client pulls toward, so 'did the varying fleet still converge' is a
+    measurable distance, not a vibe."""
+    rng = np.random.default_rng(1)
+    return {k: rng.standard_normal(shape).astype(np.float32)
+            for k, shape in _SHAPES}
 
 
 def _drift(p: dict, r: int) -> dict:
@@ -99,6 +141,32 @@ def _drift(p: dict, r: int) -> dict:
     representable in float32, so parity can be asserted bitwise."""
     step = np.float32((r % 5) + 0.25)
     return {k: v + step for k, v in p.items()}
+
+
+def _descend(p: dict, tgt: dict) -> dict:
+    """One gradient step toward ``tgt`` (lr 0.25, dyadic): unlike
+    ``_drift`` the fixed point is the same for ANY fleet size, so the
+    elastic scenarios can assert distance-to-target against a
+    fixed-fleet reference."""
+    lr = np.float32(0.25)
+    return {k: v - lr * (v - tgt[k]) for k, v in p.items()}
+
+
+def _dist(center: list, tgt: dict) -> float:
+    """Max per-leaf RMS distance between a center snapshot and the
+    target (leaf order: sorted keys, matching the pytree flatten)."""
+    worst = 0.0
+    for leaf, key in zip(center, sorted(tgt)):
+        want = tgt[key]
+        if leaf.shape != want.shape:
+            raise RuntimeError(
+                f"leaf order drifted: {leaf.shape} vs {key}:{want.shape}")
+        worst = max(worst, float(np.sqrt(np.mean((leaf - want) ** 2))))
+    return worst
+
+
+def _live(srv) -> int:
+    return len(srv.members - srv.evicted)
 
 
 def _fd_count() -> int:
@@ -130,18 +198,19 @@ def _quiet(srv) -> bool:
     with srv._lock:
         if srv._inflight:
             return False
-    return (all(q.empty() for q in srv._queues)
+    return (all(q.empty() for q in srv._queues.values())
             and all(q.empty() for q in srv._shard_queues.values()))
 
 
-def _settle_fleet(clients, srv, timeout: float = 30.0) -> None:
+def _settle_fleet(clients, srv, timeout: float | None = None) -> None:
     """Block until every submitted delta is fully applied: overlap
     senders flushed, no leg in flight, sync count stable across two
     quiet polls."""
     for cl in clients:
         if cl._sender is not None:
             cl._sender.flush()
-    deadline = time.monotonic() + timeout
+    deadline = time.monotonic() + (CHAOS_SETTLE_S if timeout is None
+                                   else timeout)
     last = -1
     while time.monotonic() < deadline:
         if _quiet(srv):
@@ -157,7 +226,8 @@ def _settle_fleet(clients, srv, timeout: float = 30.0) -> None:
 
 def _spawn_fleet(host, port, num_clients, shards, codecs, overlap,
                  centers, params, handshake_timeout=5.0,
-                 rejoin_grace=60.0):
+                 rejoin_grace=60.0, elastic=False, tau=1, alpha=0.5,
+                 adaptive_tau=False):
     """Server + clients, concurrently (both constructors block on the
     accept/dial handshake).  Returns (server, [clients], [params])."""
     box: dict = {}
@@ -165,9 +235,9 @@ def _spawn_fleet(host, port, num_clients, shards, codecs, overlap,
     def _dial(i):
         try:
             box[i] = AsyncEAClient(
-                host, port, node=i + 1, tau=1, alpha=0.5,
+                host, port, node=i + 1, tau=tau, alpha=alpha,
                 codec=codecs[i % len(codecs)], overlap=overlap,
-                centers=centers)
+                centers=centers, adaptive_tau=adaptive_tau)
         except Exception as e:  # noqa: BLE001 — surfaced below
             box[i] = e
 
@@ -178,7 +248,7 @@ def _spawn_fleet(host, port, num_clients, shards, codecs, overlap,
     srv = AsyncEAServerConcurrent(
         host, port, num_nodes=num_clients, shards=shards,
         accept_timeout=60.0, handshake_timeout=handshake_timeout,
-        rejoin_grace=rejoin_grace)
+        rejoin_grace=rejoin_grace, elastic=elastic)
     for t in threads:
         t.join(timeout=60.0)
     clients = []
@@ -395,11 +465,12 @@ def _client_self_kill(cl):
             pass
 
 
-def _recover(cl, p, deadline_s: float = 120.0):
+def _recover(cl, p, deadline_s: float | None = None):
     """Post-self-kill recovery loop: rejoin the current center (must
     wait out our own eviction), falling back to the failover dial walk
     when the center itself died meanwhile."""
-    deadline = time.monotonic() + deadline_s
+    deadline = time.monotonic() + (CHAOS_RECOVER_S if deadline_s is None
+                                   else deadline_s)
     while time.monotonic() < deadline:
         try:
             return cl.rejoin(p, retries=5, retry_interval=0.02,
@@ -526,6 +597,293 @@ def run_churn(rounds: int = 12, num_clients: int = 3, shards: int = 4,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# Elastic-fleet scenario driver (docs/ELASTIC.md).
+
+def _run_descend_reference(host, steps, *, num_clients=2, tau=1,
+                           alpha=0.5, adaptive_tau=False) -> float:
+    """Fixed-fleet oracle for the elastic scenarios: the same descent
+    dynamics with membership held constant and no faults.  Returns the
+    settled center's distance to the target."""
+    port = _reserve_window(num_clients + 3, host)
+    tgt = _target()
+    srv, clients, ps = _spawn_fleet(
+        host, port, num_clients, 1, ["raw"], False, None, _params(),
+        tau=tau, alpha=alpha, adaptive_tau=adaptive_tau)
+    try:
+        for _s in range(steps):
+            for i, cl in enumerate(clients):
+                ps[i] = _descend(ps[i], tgt)
+                ps[i], _ = cl.sync_client(ps[i])
+        _settle_fleet(clients, srv)
+        return _dist(_leaves_of(srv), tgt)
+    finally:
+        _teardown(clients, srv)
+
+
+def _drive_round(clients, ps, tgt):
+    for i, cl in enumerate(clients):
+        ps[i] = _descend(ps[i], tgt)
+        ps[i], _ = cl.sync_client(ps[i])
+
+
+def _scenario_flash_join(rounds, seed, host):
+    """The fleet doubles mid-run: 2 founding clients, 2 more flash-join
+    at rounds//3 and stay.  Peak membership must hit 2x and the settled
+    center must land within tolerance of the fixed 2-client oracle."""
+    del seed  # no faults injected — determinism comes from the dynamics
+    tgt = _target()
+    ref = _run_descend_reference(host, rounds)
+    port = _reserve_window(5, host)
+    srv, clients, ps = _spawn_fleet(host, port, 2, 1, ["raw"], False,
+                                    None, _params(), elastic=True)
+    peak = _live(srv)
+    try:
+        for r in range(rounds):
+            if r == max(1, rounds // 3):
+                for _ in range(2):
+                    cl, pj = AsyncEAClient.join(host, port, _params(),
+                                                1, 0.5, sharded=False)
+                    clients.append(cl)
+                    ps.append(pj)
+            _drive_round(clients, ps, tgt)
+            peak = max(peak, _live(srv))
+        _settle_fleet(clients, srv)
+        dist = _dist(_leaves_of(srv), tgt)
+    finally:
+        _teardown(clients, srv)
+    totals = _totals(core.REGISTRY.snapshot())
+    tol = max(4.0 * ref, 1e-3)
+    failures = []
+    if peak != 4:
+        failures.append(f"peak membership {peak}, want 4 (2x fleet)")
+    if totals.get("async_ea_membership_joins_total", 0) != 2:
+        failures.append("join counter != 2")
+    if dist > tol:
+        failures.append(f"did not converge: dist {dist:.4g} > tol "
+                        f"{tol:.4g} (reference {ref:.4g})")
+    return {"peak_members": peak, "dist": dist, "ref_dist": ref,
+            "tol": tol}, failures
+
+
+def _scenario_rolling_leave(rounds, seed, host):
+    """Join two clients (one at double capacity — the capacity-weighted
+    averaging path), then leave them one at a time through the graceful
+    ``Leave?`` flush.  Membership must return to the founding 2 with
+    every leave accounted, and convergence must hold throughout."""
+    del seed
+    tgt = _target()
+    ref = _run_descend_reference(host, rounds)
+    port = _reserve_window(5, host)
+    srv, clients, ps = _spawn_fleet(host, port, 2, 1, ["raw"], False,
+                                    None, _params(), elastic=True)
+    joined: list = []
+    peak = _live(srv)
+    leave_at = sorted({max(3, rounds // 2), max(4, (3 * rounds) // 4)})
+    try:
+        for r in range(rounds):
+            if r == 1:
+                for capacity in (1.0, 2.0):
+                    cl, pj = AsyncEAClient.join(
+                        host, port, _params(), 1, 0.5,
+                        capacity=capacity, sharded=False)
+                    clients.append(cl)
+                    ps.append(pj)
+                    joined.append(cl)
+            if r in leave_at and joined:
+                cl = joined.pop()
+                i = clients.index(cl)
+                cl.leave()
+                clients.pop(i)
+                ps.pop(i)
+            _drive_round(clients, ps, tgt)
+            peak = max(peak, _live(srv))
+        _settle_fleet(clients, srv)
+        dist = _dist(_leaves_of(srv), tgt)
+        final_live = _live(srv)
+    finally:
+        _teardown(clients, srv)
+    totals = _totals(core.REGISTRY.snapshot())
+    tol = max(4.0 * ref, 1e-3)
+    failures = []
+    if peak != 4:
+        failures.append(f"peak membership {peak}, want 4 (2x fleet)")
+    if final_live != 2:
+        failures.append(f"final membership {final_live}, want the "
+                        "founding 2")
+    if totals.get("async_ea_membership_joins_total", 0) != 2:
+        failures.append("join counter != 2")
+    if totals.get("async_ea_membership_leaves_total", 0) != 2:
+        failures.append("leave counter != 2")
+    if dist > tol:
+        failures.append(f"did not converge: dist {dist:.4g} > tol "
+                        f"{tol:.4g} (reference {ref:.4g})")
+    return {"peak_members": peak, "final_members": final_live,
+            "dist": dist, "ref_dist": ref, "tol": tol}, failures
+
+
+def _scenario_slow_node(rounds, seed, host):
+    """Straggler-adaptive τ under an injected link delay: both clients
+    run ``adaptive_tau`` at (τ=2, α=0.1); after the latency floor is
+    established, a seeded delay lands on one client's dedicated link.
+    Its effective τ must stretch above τ_lo without crossing the α·τ
+    stability bound τ_hi, and the fleet must still converge."""
+    tgt = _target()
+    steps = rounds * 2
+    ref = _run_descend_reference(host, steps, tau=2, alpha=0.1,
+                                 adaptive_tau=True)
+    port = _reserve_window(5, host)
+    srv, clients, ps = _spawn_fleet(
+        host, port, 2, 1, ["raw"], False, None, _params(),
+        tau=2, alpha=0.1, adaptive_tau=True)
+    plan = FaultPlan(seed)
+    slow = clients[1]
+    plan.wrap(slow.conn, "slow")
+    warm = max(4, steps // 3)
+    try:
+        for s in range(steps):
+            if s == warm:
+                # only now: the τ controller must stretch from an
+                # OBSERVED floor, not from a never-fast baseline
+                plan.delay("slow", 0.05)
+            _drive_round(clients, ps, tgt)
+        plan.heal("slow")
+        _settle_fleet(clients, srv)
+        dist = _dist(_leaves_of(srv), tgt)
+        tau_slow = slow.tau_effective
+        tau_fast = clients[0].tau_effective
+        lo, hi = slow._tau_lo, slow._tau_hi
+    finally:
+        _teardown(clients, srv)
+    tol = max(6.0 * ref, 5e-2)
+    failures = []
+    if tau_slow <= lo:
+        failures.append(f"adaptive tau never stretched: {tau_slow} <= "
+                        f"tau_lo {lo} despite the injected delay")
+    if tau_slow > hi:
+        failures.append(f"adaptive tau {tau_slow} crossed the "
+                        f"alpha*tau stability bound {hi}")
+    if tau_fast > lo:
+        failures.append(f"fast client stretched to {tau_fast} with no "
+                        "fault on its link")
+    if dist > tol:
+        failures.append(f"did not converge: dist {dist:.4g} > tol "
+                        f"{tol:.4g} (reference {ref:.4g})")
+    return {"tau_slow": tau_slow, "tau_fast": tau_fast,
+            "tau_bounds": [lo, hi], "dist": dist, "ref_dist": ref,
+            "tol": tol, "fault_log": len(plan.decisions())}, failures
+
+
+def _scenario_partition_heal(rounds, seed, host):
+    """One-way send partition landing EXACTLY between a sync's param
+    math and its delta push (the overlap sender's submit hook): the
+    blackholed delta 'succeeds' client-side, the server's handshake
+    timeout evicts the cid without applying it, the link heals, and the
+    rejoin replay must land the pending delta exactly once — asserted
+    BITWISE against the unkilled reference run (same guarantee the
+    parity soak proves for kill/promote, here for partition/heal)."""
+    ref_p, ref_center = _run_reference(host, rounds, overlap=True)
+    port = _reserve_window(4, host)
+    base = _params()
+    srv, (cl,), (p,) = _spawn_fleet(host, port, 1, 1, ["raw"], True,
+                                    None, base)
+    plan = FaultPlan(seed)
+    plan.wrap(cl.conn, "c1")
+    k = max(1, rounds // 2)
+    failures = []
+    try:
+        for r in range(rounds):
+            p = _drift(p, r)
+            if r == k:
+                orig = cl._sender.submit
+
+                def _cut(job, _orig=orig):
+                    plan.partition("c1", "send")
+                    return _orig(job)
+
+                cl._sender.submit = _cut
+                p, _ = cl.sync_client(p)
+                cl._sender.submit = orig
+                # the push is blackholed mid-handshake; the server's
+                # handshake timeout must evict without applying seq k
+                deadline = time.monotonic() + CHAOS_RECOVER_S
+                while (cl.node not in srv.evicted
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                if cl.node not in srv.evicted:
+                    failures.append("server never evicted the "
+                                    "partitioned client")
+                plan.heal("c1")
+            else:
+                p = _sync_with_failover(cl, p)
+        _settle_fleet([cl], srv)
+        center = _leaves_of(srv)
+    finally:
+        _teardown([cl], srv)
+    totals = _totals(core.REGISTRY.snapshot())
+    dropped = plan.dropped_bytes("c1")
+    for i, (a, b) in enumerate(zip(ref_center, center)):
+        if a.dtype != b.dtype or not np.array_equal(a, b):
+            failures.append(f"center leaf {i} diverged "
+                            f"(max |d|={np.abs(a - b).max()})")
+    for key in ref_p:
+        if not np.array_equal(ref_p[key], p[key]):
+            failures.append(f"client param {key!r} diverged")
+    if dropped <= 0:
+        failures.append("partition blackholed no bytes — the fault "
+                        "never landed on the delta push")
+    if totals.get("async_ea_evictions_total", 0) < 1:
+        failures.append("no eviction recorded")
+    if totals.get("async_ea_rejoins_total", 0) < 1:
+        failures.append("no rejoin recorded — the replay path never ran")
+    return {"partition_round": k, "dropped_bytes": dropped,
+            "evictions": totals.get("async_ea_evictions_total", 0),
+            "rejoins": totals.get("async_ea_rejoins_total", 0)}, failures
+
+
+_SCENARIOS = {
+    "flash_join": _scenario_flash_join,
+    "rolling_leave": _scenario_rolling_leave,
+    "slow_node": _scenario_slow_node,
+    "partition_heal": _scenario_partition_heal,
+}
+
+
+def run_scenario(name: str, rounds: int = 12, seed: int = 0,
+                 host: str = "127.0.0.1") -> dict:
+    """Run one named elastic chaos scenario (see module docstring) and
+    assert its invariants + zero fd/thread leaks.  Deterministically
+    seeded: every injected fault decision flows from ``seed`` through
+    the FaultPlan's per-link RNG streams."""
+    if name not in _SCENARIOS:
+        raise ValueError(f"unknown scenario {name!r} "
+                         f"(have: {', '.join(sorted(_SCENARIOS))})")
+    if rounds < 8:
+        raise ValueError("scenarios need rounds >= 8 (join/leave/fault "
+                         "rounds must stay distinct)")
+    core.configure(True)
+    core.REGISTRY.reset()
+    fd_base, th_base = _fd_count(), threading.active_count()
+    try:
+        fields, failures = _SCENARIOS[name](rounds, seed, host)
+        fd_end, th_end = _settle_leaks(fd_base, th_base)
+        if fd_end > fd_base + 2:
+            failures.append(f"fd leak: {fd_base} -> {fd_end}")
+        if th_end > th_base:
+            failures.append(f"thread leak: {th_base} -> {th_end}")
+        report = {"scenario": name, "rounds": rounds, "seed": seed,
+                  **fields, "fds": [fd_base, fd_end],
+                  "threads": [th_base, th_end], "failures": failures}
+        if failures:
+            raise AssertionError(f"chaos scenario {name} failed: "
+                                 + "; ".join(failures)
+                                 + f"\n{json.dumps(report, indent=2)}")
+        return report
+    finally:
+        core.REGISTRY.reset()
+        core.configure(None)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="chaos", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -544,8 +902,17 @@ def main(argv=None) -> int:
     cp.add_argument("--shards", type=int, default=4)
     cp.add_argument("--server-kills", type=int, default=2)
     cp.add_argument("--no-overlap", action="store_true")
+    sp = sub.add_parser("scenario",
+                        help="elastic membership chaos scenarios")
+    sp.add_argument("--name", required=True,
+                    choices=sorted(_SCENARIOS))
+    sp.add_argument("--rounds", type=int, default=12)
+    sp.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    if args.cmd == "parity":
+    if args.cmd == "scenario":
+        report = run_scenario(args.name, rounds=args.rounds,
+                              seed=args.seed)
+    elif args.cmd == "parity":
         kills = [int(k) for k in str(args.kills).split(",") if k.strip()]
         report = run_parity(rounds=args.rounds, kills=kills,
                             shards=args.shards,

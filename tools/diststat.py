@@ -253,6 +253,57 @@ def failover_table(counter_totals: dict, counters: dict,
     return tab
 
 
+_MEMBER_COUNTERS = {
+    "async_ea_membership_joins_total": "joins",
+    "async_ea_membership_join_failures_total": "join_failures",
+}
+_LEAVES_FAM = "async_ea_membership_leaves_total"
+_TAU_GAUGE = "async_ea_adaptive_tau"
+_MEMBER_SPANS = ("async_ea.join", "async_ea.leave")
+
+
+def membership_table(counter_totals: dict, counters: dict, gauges: dict,
+                     spans: dict) -> dict:
+    """Derive the elastic-membership table (docs/ELASTIC.md): Join?
+    admissions and refusals, Leave? departures by pending-delta outcome
+    (``flushed`` / ``clean`` / ``dropped``), the final live fleet size,
+    each client's straggler-adapted effective τ, and the join/leave
+    handshake latency quantiles.  Empty when the run's fleet was fixed —
+    so a populated table is itself the proof the server ran elastic."""
+    tab: dict = {}
+    for fam, col in _MEMBER_COUNTERS.items():
+        v = counter_totals.get(fam, 0)
+        if v:
+            tab[col] = v
+    leaves = {}
+    prefix = _LEAVES_FAM + '{outcome="'
+    for key, v in counters.items():
+        if key.startswith(prefix) and key.endswith('"}'):
+            leaves[key[len(prefix):-2]] = v
+    if leaves:
+        tab["leaves"] = dict(sorted(leaves.items()))
+    size = gauges.get("async_ea_membership_size")
+    if size is not None and (tab or size):
+        tab["fleet_size"] = size
+    tau, tprefix = {}, _TAU_GAUGE + '{cid="'
+    for key, v in gauges.items():
+        if key.startswith(tprefix) and key.endswith('"}'):
+            tau[key[len(tprefix):-2]] = v
+    if tau:
+        tab["adaptive_tau"] = dict(sorted(tau.items(),
+                                          key=lambda kv: (len(kv[0]), kv[0])))
+    lat = {}
+    for name in _MEMBER_SPANS:
+        durs = spans.get(name)
+        if durs:
+            lat[name] = {"count": len(durs),
+                         "p50": _percentile(durs, 50),
+                         "p99": _percentile(durs, 99)}
+    if lat:
+        tab["latency"] = lat
+    return tab
+
+
 _SERVE_SPANS = {"serve.ttft": "ttft", "serve.tpot": "tpot",
                 "serve.prefill": "prefill", "serve.tick": "tick"}
 _SERVE_OUTCOMES = 'serve_requests_total{outcome="'
@@ -313,6 +364,9 @@ def summarize_run(paths: list[str]) -> dict:
             "shards": shard_table(run["counters"], run["histograms"]),
             "failover": failover_table(run["counter_totals"],
                                        run["counters"], run["spans"]),
+            "membership": membership_table(run["counter_totals"],
+                                           run["counters"], run["gauges"],
+                                           run["spans"]),
             "serving": serving_table(run["counter_totals"],
                                      run["counters"], run["spans"])}
 
@@ -431,6 +485,20 @@ def _print_summary(doc: dict):
         for outcome, v in fo.get("replays", {}).items():
             print(f"  replays[{outcome}] = {v:g}")
         for name, row in fo.get("latency", {}).items():
+            print(f"  {name}: count={row['count']} "
+                  f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
+        print()
+    if doc.get("membership"):
+        mb = doc["membership"]
+        print("membership:")
+        for col in ("joins", "join_failures", "fleet_size"):
+            if col in mb:
+                print(f"  {col} = {mb[col]:g}")
+        for outcome, v in mb.get("leaves", {}).items():
+            print(f"  leaves[{outcome}] = {v:g}")
+        for cid, v in mb.get("adaptive_tau", {}).items():
+            print(f"  adaptive_tau[cid={cid}] = {v:g}")
+        for name, row in mb.get("latency", {}).items():
             print(f"  {name}: count={row['count']} "
                   f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
         print()
