@@ -51,6 +51,14 @@ Shipped models (:func:`builtin_models`):
   worker and double-applies, the seeded DL303), and every membership
   change renormalizes the capacity weights so the fleet's total weight
   mass is conserved (``renorm=False`` is the seeded DL304).
+* ``router``          — the serving-fleet router
+  (``serve/router.py``): dispatch/retry/shed/hedge over dying,
+  shedding, hot-swapping replicas — deadlock-free only because dead
+  replicas' queued requests are resubmitted (``retry=False`` is the
+  seeded DL301), no stream splices two center epochs
+  (``fence=False`` is the seeded DL302), and execution stays
+  at-most-once per replica (``single_dispatch=False`` is the seeded
+  DL303).
 
 State spaces are deliberately tiny (1 client, 2 stripes, 2 requests,
 small budgets) so the exhaustive sweep stays well under a second of
@@ -70,7 +78,7 @@ from distlearn_tpu.lint.core import Finding
 __all__ = [
     "ModelSpec", "ModelReport", "check_model", "builtin_models",
     "sync_model", "sharded_model", "replay_model", "failover_model",
-    "serve_model", "membership_model", "lint_models",
+    "serve_model", "membership_model", "router_model", "lint_models",
 ]
 
 State = Hashable
@@ -832,12 +840,180 @@ def membership_model(*, join_fence: bool = True, leave_flush: bool = True,
 
 
 # ---------------------------------------------------------------------------
+# The serving-fleet router (serve/router.py): dispatch, retry-on-death,
+# shed, hedge, epoch fence — deadlock-free (DL301), never splicing two
+# center epochs into one stream (DL302), at-most-once per replica
+# (DL303).
+
+def router_model(*, retry: bool = True, fence: bool = True,
+                 single_dispatch: bool = True) -> ModelSpec:
+    """Fleet router request lifecycle (``serve/router.py``): one request
+    against two replicas A/B, each of which may die at any point, shed a
+    dispatch (queue full), or hot-swap its center epoch mid-run
+    (``serve.server._maybe_swap``).  The router moves exactly as
+    ``Router.generate`` does: dispatch to an untried live replica,
+    resubmit only requests that never produced a token, hedge off a slow
+    replica by CANCELLING its queued copy first, surface a clean
+    terminal when every replica was tried, and fence the stream on the
+    'R'-chunk epoch echo.
+
+    The three guards under test, each with a seeded mutation:
+
+    * ``retry``           — a replica that dies holding a
+      queued-not-yet-prefilled request triggers resubmission to a
+      survivor.  ``retry=False`` leaves the request parked on the dead
+      replica forever: once the environment's remaining actions
+      exhaust, the state has no successor and is not terminal — DL301.
+    * ``fence``           — the first chunk pins the stream's epoch and
+      a later chunk with a different value terminates the stream
+      (clean ``failed``).  ``fence=False`` delivers it: one completion
+      spliced from two model versions — DL302.
+    * ``single_dispatch`` — the tried-set plus hedge-cancel keep
+      execution at-most-once per replica.  ``single_dispatch=False``
+      hedges WITHOUT cancelling and forgets the replica was tried, so
+      a later dispatch hands the same replica a second live copy —
+      DL303.
+
+    State: ``(phase, owner, first_ep, mixed, ((up, ep, copies, tried)
+    per replica))``.
+    """
+    names = ("A", "B")
+    init = ("new", -1, -1, False, ((True, 0, 0, False),
+                                   (True, 0, 0, False)))
+
+    def _set(reps, i, **kw):
+        up, ep, cp, tr = reps[i]
+        rep = (kw.get("up", up), kw.get("ep", ep),
+               kw.get("copies", cp), kw.get("tried", tr))
+        return tuple(rep if j == i else reps[j] for j in range(2))
+
+    def actions(state):
+        phase, owner, first_ep, mixed, reps = state
+        if phase in ("done", "failed", "shed"):
+            return []
+        acts = []
+        # environment: replica deaths and hot swaps, in every order
+        for i in range(2):
+            up, ep, _cp, _tr = reps[i]
+            if up:
+                acts.append((f"fault: replica {names[i]} dies",
+                             (phase, owner, first_ep, mixed,
+                              _set(reps, i, up=False))))
+                if ep == 0:
+                    acts.append((
+                        f"replica {names[i]} hot-swaps to epoch 1",
+                        (phase, owner, first_ep, mixed,
+                         _set(reps, i, ep=1))))
+        if phase == "new":
+            cand = [i for i in range(2) if reps[i][0] and not reps[i][3]]
+            for i in cand:
+                acts.append((
+                    f"router dispatches to {names[i]}; it ACCEPTS "
+                    "(copy queued)",
+                    # copies clamp at 2: one over the at-most-once bound
+                    # witnesses the violation; an unbounded counter would
+                    # make the mutated model's state space infinite
+                    ("queued", i, first_ep, mixed,
+                     _set(reps, i, copies=min(reps[i][2] + 1, 2),
+                          tried=True))))
+                acts.append((
+                    f"router dispatches to {names[i]}; it SHEDS "
+                    "(queue full, retry_after)",
+                    ("new", -1, first_ep, mixed,
+                     _set(reps, i, tried=True))))
+            if not cand:
+                acts.append((
+                    "router surfaces RouterBusy/ReplicaDead: every "
+                    "replica tried or dead",
+                    ("shed", -1, first_ep, mixed, reps)))
+        elif phase == "queued":
+            up, ep, cp, _tr = reps[owner]
+            if up:
+                acts.append((
+                    f"replica {names[owner]} prefills: first chunk pins "
+                    f"stream epoch {ep}",
+                    ("streaming", owner, ep, mixed, reps)))
+                if single_dispatch:
+                    if any(reps[j][0] and not reps[j][3]
+                           for j in range(2) if j != owner):
+                        acts.append((
+                            "hedge: router cancels the queued copy on "
+                            f"{names[owner]} (conn close) and resubmits",
+                            ("new", -1, first_ep, mixed,
+                             _set(reps, owner, copies=cp - 1))))
+                else:
+                    acts.append((
+                        "hedge WITHOUT cancel: router forgets it tried "
+                        f"{names[owner]}, old copy still queued there "
+                        "(single-dispatch guard dropped)",
+                        ("new", -1, first_ep, mixed,
+                         _set(reps, owner, tried=False))))
+            elif retry:
+                acts.append((
+                    f"router detects {names[owner]} died before the "
+                    "first token: resubmits to a survivor",
+                    ("new", -1, first_ep, mixed, reps)))
+            # retry dropped: no router action — the request wedges on
+            # the dead replica (the seeded DL301)
+        elif phase == "streaming":
+            up, ep, _cp, _tr = reps[owner]
+            if up:
+                if ep == first_ep:
+                    acts.append((
+                        f"replica {names[owner]} streams to completion "
+                        "(epoch stable)",
+                        ("done", owner, first_ep, mixed, reps)))
+                elif fence:
+                    acts.append((
+                        f"chunk carries epoch {ep} != pinned {first_ep}:"
+                        " router fences the stream (clean failed chunk)",
+                        ("failed", owner, first_ep, mixed, reps)))
+                else:
+                    acts.append((
+                        f"chunk carries epoch {ep} != pinned {first_ep} "
+                        "and the router DELIVERS it (fence dropped)",
+                        ("done", owner, first_ep, True, reps)))
+            else:
+                acts.append((
+                    f"replica {names[owner]} died mid-stream: router "
+                    "returns a clean terminal failed chunk (no resubmit"
+                    " — tokens already flowed)",
+                    ("failed", owner, first_ep, mixed, reps)))
+        return acts
+
+    def invariant(state):
+        _phase, _owner, _first_ep, mixed, reps = state
+        out = []
+        if mixed:
+            out.append((
+                "DL302",
+                "one stream delivered chunks from two center epochs — "
+                "the router's fence over the 'R'-chunk epoch echo is "
+                "missing and a completion spliced two model versions"))
+        for i in range(2):
+            if reps[i][2] > 1:
+                out.append((
+                    "DL303",
+                    f"replica {names[i]} holds {reps[i][2]} live copies "
+                    "of one request — a resubmission skipped the "
+                    "tried-set/hedge-cancel guard, so execution is no "
+                    "longer at-most-once per replica"))
+        return out
+
+    def is_terminal(state):
+        return state[0] in ("done", "failed", "shed")
+
+    return ModelSpec("router", init, actions, invariant, is_terminal)
+
+
+# ---------------------------------------------------------------------------
 # Repo-facing entries.
 
 def builtin_models() -> list[ModelSpec]:
     """The shipped models in their faithful (unmutated) configuration."""
     return [sync_model(), sharded_model(), replay_model(),
-            failover_model(), serve_model(), membership_model()]
+            failover_model(), serve_model(), membership_model(),
+            router_model()]
 
 
 def lint_models() -> "list[tuple[ModelReport, ModelSpec]]":

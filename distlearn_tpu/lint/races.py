@@ -130,6 +130,17 @@ BENIGN_FIELDS: dict = {
         "write-once failure latch published by the dying loop for "
         "health() readers ('record it, flip health'); a str attribute "
         "store is GIL-atomic",
+    ("ServeServer", "epoch"):
+        "written only by the serve loop's _maybe_swap; health() probes "
+        "take GIL-atomic int snapshots — 'a probe racing a swap sees "
+        "either epoch, both valid' (server.py epoch docstring)",
+    ("ServeServer", "ckpt_step"):
+        "same single-writer discipline as epoch: loop-only writes, "
+        "GIL-atomic health() reads (server.py epoch docstring)",
+    ("ServeServer", "_swap_pending"):
+        "loop-only two-phase swap latch; health() reads only its "
+        "None-ness for the swap_pending flag — a tuple attribute "
+        "store is GIL-atomic (server.py epoch docstring)",
     # -- obs/core.py --------------------------------------------------------
     ("_Counter", "value"):
         "documented lock-cheap metric path: plain attribute increments "

@@ -360,7 +360,8 @@ class AsyncEAServer:
                  with_tester: bool = False, accept_timeout: float = 120.0,
                  handshake_timeout: float | None = 30.0, shards: int = 1,
                  throttle_bps: float | None = None, standby: bool = False,
-                 elastic: bool = False):
+                 elastic: bool = False,
+                 centers: list[tuple[str, int]] | None = None):
         import threading
         self.num_nodes = num_nodes
         self._host = host
@@ -370,6 +371,12 @@ class AsyncEAServer:
         # ports) and retires them through Leave? — the fleet is a live
         # roster, not a construction-time constant.
         self.elastic = bool(elastic)
+        # HA dial list advertised to joiners in the Join reply (the same
+        # ``--centers`` roster founding clients get on the command line),
+        # so a Join?-admitted client can failover() like everyone else
+        # instead of dying with its center (docs/ELASTIC.md).
+        self.advertised_centers: list[tuple[str, int]] = [
+            (h, int(p)) for h, p in (centers or [])]
         # Live roster: every admitted cid (initial fleet + joiners, minus
         # leavers).  Ids are NEVER reused — the exactly-once ledger and
         # the concurrent server's generation counters stay unambiguous.
@@ -1181,6 +1188,11 @@ class AsyncEAServer:
                 reply: dict[str, Any] = {"a": JOIN, "clientID": cid,
                                          "port": ded.port,
                                          "epoch": self.epoch}
+                if self.advertised_centers:
+                    # the joiner's failover dial list — without it a
+                    # joiner only ever knows the center admitting it
+                    reply["centers"] = [[h, p] for h, p
+                                        in self.advertised_centers]
                 if codec is not None:
                     reply["wire"] = {"v": wire.WIRE_V, "codec": codec}
                 conn_b.set_timeout(self.handshake_timeout)
@@ -1659,12 +1671,14 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                  handshake_timeout: float | None = 30.0,
                  pin_device=None, rejoin_grace: float = 10.0,
                  shards: int = 1, throttle_bps: float | None = None,
-                 standby: bool = False, elastic: bool = False):
+                 standby: bool = False, elastic: bool = False,
+                 centers: list[tuple[str, int]] | None = None):
         super().__init__(host, port, num_nodes, with_tester=with_tester,
                          accept_timeout=accept_timeout,
                          handshake_timeout=handshake_timeout,
                          shards=shards, throttle_bps=throttle_bps,
-                         standby=standby, elastic=elastic)
+                         standby=standby, elastic=elastic,
+                         centers=centers)
         # How long the dispatcher keeps polling for a Rejoin? after every
         # broadcast conn has closed WHILE somebody is evicted — bounded so
         # a permanently-dead evictee cannot hold up shutdown/drained.
@@ -3135,8 +3149,8 @@ class AsyncEAClient:
         # a joiner's dedicated channel is the ephemeral listener the Join
         # reply advertised — it survives evictions (only _remove_member
         # closes it), so rejoin works against the SAME center; a promoted
-        # standby never heard of it, so a joiner failing over re-enters
-        # through a fresh join() instead (docs/ELASTIC.md)
+        # standby never heard of it, so failover() routes joiners through
+        # _join_handshake (a fresh Join? under a new cid) instead of here
         self.conn = connect(self.host,
                             self.port + self.node if self._ded_port is None
                             else self._ded_port,
@@ -3201,6 +3215,106 @@ class AsyncEAClient:
         _expect(self.conn, ACK)
         self._c_replays.labels(outcome="replayed").inc()
 
+    def _join_handshake(self, n_leaves: int, retries: int,
+                        retry_interval: float,
+                        handshake_timeout: float | None,
+                        host: str, port: int) -> None:
+        """Failover re-entry for a ``Join?``-admitted client: its
+        dedicated channel is an ephemeral listener that only ever
+        existed on the dead center, so a promoted standby cannot
+        complete a ``Rejoin?`` handshake for it.  Instead re-enter
+        through a FRESH ``Join?`` — new cid, new ephemeral dedicated
+        port — keeping local params and residuals exactly as
+        :meth:`failover` does for founding clients.  Epoch-fenced
+        client-side: a center whose epoch is behind the newest we have
+        seen is a zombie and raises :class:`StaleCenterError` so the
+        failover walk removes it permanently.
+
+        The new cid has no applied-seq ledger entry, so a pending delta
+        cannot be replayed exactly-once — it is dropped (EA absorbs a
+        lost delta; double-applying one is the bug), mirroring the
+        promoted-without-seq path in :meth:`_replay_exchange`."""
+        if self._sender is not None:
+            self._sender.drain()
+        for c in (self.broadcast, self.conn, *self._shard_conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._shard_spec = None
+        self._stripes = None
+        self._splits = None
+        self._shard_conns = []
+        self.host, self.port = host, port
+        b = connect(host, port, retries=retries,
+                    retry_interval=retry_interval)
+        try:
+            b.set_timeout(handshake_timeout)
+            msg: dict[str, Any] = {"q": JOIN_Q, "capacity": self.capacity}
+            if self.codec is not None:
+                msg["wire"] = {"v": wire.WIRE_V, "codec": self.codec}
+                if self.sharded:
+                    msg["shard"] = {"v": SHARD_V}
+            b.send_msg(msg)
+            reply = b.recv_msg()
+            if not (isinstance(reply, dict) and reply.get("a") == JOIN):
+                raise ProtocolError(
+                    f"protocol desync: expected {JOIN!r} reply, "
+                    f"got {reply!r}")
+            ep = reply.get("epoch")
+            if isinstance(ep, int):
+                if (self._seen_epoch is not None
+                        and ep < self._seen_epoch):
+                    raise StaleCenterError(
+                        f"join admitted by a stale center: epoch {ep} "
+                        f"< seen {self._seen_epoch}")
+                self._seen_epoch = ep
+            w = reply.get("wire")
+            if isinstance(w, dict) and w.get("error"):
+                raise ProtocolError(str(w["error"]))
+            cid, dport = reply.get("clientID"), reply.get("port")
+            if not (isinstance(cid, int) and isinstance(dport, int)):
+                raise ProtocolError(f"malformed {JOIN!r} reply {reply!r}")
+            b.set_timeout(None)
+        except BaseException:
+            b.close()
+            raise
+        self.broadcast = b
+        was = self.node
+        self.node = cid
+        self._ded_port = dport
+        self.conn = connect(host, dport, retries=retries,
+                            retry_interval=retry_interval)
+        if self.throttle_bps:
+            self.conn.throttle_bps = self.throttle_bps
+        self.conn.set_timeout(handshake_timeout)
+        dl = (None if handshake_timeout is None
+              else time.monotonic() + handshake_timeout)
+        self.center = self.conn.recv_tensors(n=n_leaves, deadline=dl)
+        self.conn.send_msg(ACK)
+        self.conn.set_timeout(None)
+        self._packed = isinstance(w, dict)
+        hint = reply.get("centers")
+        if isinstance(hint, list):
+            self._adopt_centers_hint(hint)
+        if self._pending is not None:
+            self._pending = None
+            self._c_replays.labels(outcome="dropped").inc()
+        print_client(self.node, f"re-joined the fleet as #{cid} "
+                     f"(was #{was})")
+
+    def _adopt_centers_hint(self, hint) -> None:
+        """Fold a Join-reply ``centers`` roster into the failover dial
+        list (dedup, current center kept first)."""
+        for item in hint:
+            try:
+                h, p = item
+                addr = (str(h), int(p))
+            except (TypeError, ValueError):
+                continue
+            if addr not in self._centers:
+                self._centers.append(addr)
+
     def rejoin(self, params: PyTree, retries: int = 60,
                retry_interval: float = 0.25,
                handshake_timeout: float | None = 60.0) -> PyTree:
@@ -3239,6 +3353,11 @@ class AsyncEAClient:
         the epoch fence is removed from the dial list permanently.
         Returns ``params`` unchanged; raises ``ConnectionError`` when the
         dial list is exhausted.
+
+        A ``Join?``-admitted client (ephemeral dedicated port) re-enters
+        through a fresh ``Join?`` under a new cid instead of ``Rejoin?``
+        — see :meth:`_join_handshake`; its dial list comes from the
+        ``centers`` roster its join reply carried.
         """
         n = len(_leaves(params))
         with obs.span("async_ea.failover", cid=self.node):
@@ -3248,11 +3367,12 @@ class AsyncEAClient:
                 host, port = self._centers[self._center_i
                                            % len(self._centers)]
                 self._c_redials.inc()
+                enter = (self._join_handshake if self._ded_port is not None
+                         else self._rejoin_handshake)
                 try:
-                    self._rejoin_handshake(
-                        n, retries=3, retry_interval=retry_interval,
-                        handshake_timeout=handshake_timeout,
-                        host=host, port=port)
+                    enter(n, retries=3, retry_interval=retry_interval,
+                          handshake_timeout=handshake_timeout,
+                          host=host, port=port)
                 except StaleCenterError:
                     # MUST come before ProtocolError (its base class):
                     # a fenced-off center can never become valid again
@@ -3322,6 +3442,12 @@ class AsyncEAClient:
             ep = reply.get("epoch")
             if isinstance(ep, int):
                 cl._seen_epoch = ep
+            # the join ACK's ``centers`` roster is the joiner's failover
+            # dial list — with it a joiner survives a center kill through
+            # failover() exactly like a founding client (docs/ELASTIC.md)
+            hint = reply.get("centers")
+            if isinstance(hint, list):
+                cl._adopt_centers_hint(hint)
             # the join reply echoing the wire advertisement plays the role
             # of the Enter reply in _announce: packed wire is negotiated
             cl._packed = isinstance(w, dict)

@@ -19,18 +19,27 @@ Layers, bottom up:
 * ``serve.server`` / ``serve.client`` — :class:`ServeServer` wires the
   scheduler to ``comm.transport`` ('G'/'R' frames), ``obs`` (gauges,
   TTFT/TPOT histograms + spans, ``/healthz``) and SIGTERM drain via
-  ``ha.install_signal_flush``; :class:`ServeClient` is the matching
-  one-request driver.
+  ``ha.install_signal_flush``, and hot-swaps weights from a tailed
+  checkpoint directory (:class:`WeightTailer`, epoch-fenced);
+  :class:`ServeClient` is the matching one-request driver with typed
+  failure classification (:class:`ReplicaDead`) and shed-hint backoff.
+* ``serve.router`` — :class:`Router`: shared-nothing fleet front —
+  least-loaded health-routed dispatch, retry-on-replica-death for
+  queued-not-prefilled requests, load shedding (:class:`RouterBusy`
+  with ``retry_after``), deadline-aware hedging, and the epoch fence
+  over the hot-swap echo.
 
-Demo: ``examples/lm.py --serve`` + ``examples/lm_client.py``; protocol
-and runbook in docs/SERVING.md.
+Demo: ``examples/lm.py --serve`` + ``examples/lm_client.py``; fleet
+demo ``examples/serve_fleet.py``; protocol and runbook in
+docs/SERVING.md.
 """
 
-from distlearn_tpu.serve.client import ServeClient, ServeError
+from distlearn_tpu.serve.client import ReplicaDead, ServeClient, ServeError
 from distlearn_tpu.serve.engine import DecodeEngine
 from distlearn_tpu.serve.kv_cache import CacheFull, PagedKVCache
+from distlearn_tpu.serve.router import Router, RouterBusy
 from distlearn_tpu.serve.scheduler import Event, QueueFull, Request, Scheduler
-from distlearn_tpu.serve.server import ServeServer
+from distlearn_tpu.serve.server import ServeServer, WeightTailer
 
 __all__ = [
     "CacheFull",
@@ -38,9 +47,13 @@ __all__ = [
     "Event",
     "PagedKVCache",
     "QueueFull",
+    "ReplicaDead",
     "Request",
+    "Router",
+    "RouterBusy",
     "Scheduler",
     "ServeClient",
     "ServeError",
     "ServeServer",
+    "WeightTailer",
 ]

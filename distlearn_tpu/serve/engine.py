@@ -293,6 +293,32 @@ class DecodeEngine:
         """Release the slot's pages (request done or evicted)."""
         self.cache.release(slot)
 
+    def swap_params(self, params: PyTree) -> None:
+        """Hot-swap the served weights between ticks (the zero-downtime
+        deployment path — ``serve.server`` fences admissions around the
+        call).  The compiled tick/prefill programs take ``params`` as
+        argument 0 and close over nothing, so replacing the tree is
+        visible on the next dispatch with NO retrace — provided the new
+        tree matches the compiled signature exactly; structure, shape
+        and dtype are validated here so a layout drift fails the swap,
+        not the next request."""
+        jax, jnp = self._jax, self._jnp
+        new, depth = generate_params(params)
+        if depth != self.depth:
+            raise ValueError(f"swap depth {depth} != engine depth "
+                             f"{self.depth}")
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def:
+            raise ValueError("swap param tree structure differs from the "
+                             "compiled one")
+        for o, n in zip(old_leaves, new_leaves):
+            if tuple(o.shape) != tuple(n.shape) or o.dtype != n.dtype:
+                raise ValueError(
+                    f"swap leaf mismatch: {n.shape}/{n.dtype} where the "
+                    f"engine compiled {o.shape}/{o.dtype}")
+        self.params = jax.tree_util.tree_map(jnp.asarray, new)
+
     # -- lint/bench hooks ---------------------------------------------------
     def tick_args(self):
         """Abstract args for the decode-tick program (distlint's cost

@@ -36,7 +36,18 @@ from distlearn_tpu.serve.engine import DecodeEngine
 
 
 class QueueFull(RuntimeError):
-    """Admission queue at capacity — client should back off and retry."""
+    """Admission queue at capacity — client should back off and retry.
+
+    Carries enough context for the rejection chunk to be actionable:
+    ``queue_depth`` (how far behind the server is) and ``retry_after``
+    (a seconds hint; ``None`` means "don't retry here" — e.g. the
+    server is draining and will never admit again)."""
+
+    def __init__(self, msg: str, *, queue_depth: int | None = None,
+                 retry_after: float | None = None):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.retry_after = retry_after
 
 
 _RIDS = itertools.count(1)
@@ -79,6 +90,11 @@ class Scheduler:
         self._queue: deque[Request] = deque()
         self._running: dict[str, Request] = {}    # rid -> Request
         self._by_slot: dict[int, Request] = {}
+        #: admissions fence: while True, queued requests stay queued
+        #: (submit still accepts up to max_queue).  The server raises it
+        #: around an epoch swap so no request prefills under outgoing
+        #: params while survivors of the old epoch drain.
+        self.hold = False
 
     # -- introspection (server gauges) --------------------------------------
     def queue_depth(self) -> int:
@@ -95,6 +111,15 @@ class Scheduler:
 
     def _live(self, rid: str) -> bool:
         return rid in self._running or any(r.rid == rid for r in self._queue)
+
+    def retry_after_hint(self) -> float:
+        """Seconds a rejected client should wait before retrying HERE.
+        A coarse backlog proxy — per-request service time isn't known
+        at admission, so the hint only needs to scale with how far
+        behind the server is, clamped to [0.05s, 5s] so it neither
+        thundering-herds nor parks clients forever."""
+        backlog = len(self._queue) + len(self._running)
+        return min(5.0, max(0.05, 0.05 * backlog))
 
     # -- client-facing ------------------------------------------------------
     def submit(self, prompt, max_new: int, *, rid: str | None = None,
@@ -118,7 +143,10 @@ class Scheduler:
                 f"prompt+max_new = {prompt.size + max_new} exceeds engine "
                 f"max_len {self.engine.max_len}")
         if len(self._queue) >= self.max_queue:
-            raise QueueFull(f"admission queue at capacity ({self.max_queue})")
+            raise QueueFull(
+                f"admission queue at capacity ({self.max_queue})",
+                queue_depth=len(self._queue),
+                retry_after=self.retry_after_hint())
         if rid is None:
             rid = str(next(_RIDS))
             while self._live(rid):      # a client squatted on this numeral
@@ -175,6 +203,8 @@ class Scheduler:
             events.append(Event("finish", req.rid, reason="deadline"))
 
     def _admit(self, events: list[Event]):
+        if self.hold:
+            return
         while self._queue:
             req = self._queue[0]
             if not self.engine.has_capacity(req.prompt.size, req.max_new):

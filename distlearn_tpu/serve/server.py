@@ -18,6 +18,13 @@ Wires the three serving layers to the rest of the repo:
   admissions, lets in-flight requests decode to completion (bounded by
   ``drain_timeout``), then lets the signal's prior disposition run.  No
   new flush machinery: serving reuses the HA hook verbatim.
+* **Hot swap**: with ``ckpt_dir`` set, a :class:`WeightTailer` tails the
+  training center's checkpoint directory (the ``ha.StandbyCenter``
+  watch pattern, serving side) and the loop swaps params between ticks
+  — epoch-fenced: admissions hold while old-epoch streams drain, then
+  the new weights install atomically, so no stream ever observes two
+  center epochs.  The serving epoch rides ``/healthz`` and every 'R'
+  chunk, which is what ``serve.router`` asserts on.
 
 The request loop runs in ONE thread (foreground ``serve_forever`` or
 background ``start``): sockets are select-ed, the scheduler steps, and
@@ -44,6 +51,7 @@ from distlearn_tpu.comm import transport
 from distlearn_tpu.comm.transport import ProtocolError
 from distlearn_tpu.serve.engine import DecodeEngine
 from distlearn_tpu.serve.scheduler import QueueFull, Scheduler
+from distlearn_tpu.utils.checkpoint import latest_step, restore_checkpoint
 from distlearn_tpu.utils.logging import print_server
 
 #: TTFT/TPOT buckets (seconds): wider than the wire-latency default —
@@ -52,11 +60,66 @@ _LAT_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
                 1.0, 2.5, 5.0, 10.0)
 
 
+class WeightTailer:
+    """Tail a checkpoint directory for new weights to serve — the
+    ``ha.StandbyCenter`` watch-probe pattern pointed at serving instead
+    of promotion.  :meth:`maybe_load` is polled from the request loop;
+    at most one ``latest_step`` stat per ``poll`` seconds, and a load
+    only when an unseen step appears.
+
+    Both tree layouts the repo writes are accepted: params-shaped
+    checkpoints (``save_checkpoint(dir, step, params)``) and the HA
+    center layout ``{"center": {"<i>": leaf}}`` that the training
+    center's ``_checkpoint_locked`` produces (tried second, via
+    ``ha.restore_center``)."""
+
+    def __init__(self, directory: str, like, *, poll: float = 0.25):
+        self.directory = str(directory)
+        self.like = like
+        self.poll = float(poll)
+        self._last_step: int | None = None
+        self._warned_step: int | None = None
+        self._next_poll = 0.0
+
+    def poll_step(self) -> int | None:
+        return latest_step(self.directory)
+
+    def maybe_load(self, now: float):
+        """``(params, meta)`` for an unseen newest step, else ``None``.
+        A torn or foreign file is skipped (warned once) and re-tried
+        next poll — a checkpoint racing its own rename completes soon."""
+        if now < self._next_poll:
+            return None
+        self._next_poll = now + self.poll
+        step = self.poll_step()
+        if step is None or step == self._last_step:
+            return None
+        try:
+            tree, meta = self._restore(step)
+        except (OSError, KeyError, ValueError) as e:
+            if step != self._warned_step:
+                self._warned_step = step
+                print_server(f"weight tailer: step {step} unreadable, "
+                             f"will retry: {e!r}")
+            return None
+        self._last_step = step
+        return tree, meta
+
+    def _restore(self, step: int):
+        try:
+            return restore_checkpoint(self.directory, self.like, step=step)
+        except (KeyError, ValueError):
+            from distlearn_tpu.parallel.ha import restore_center
+            return restore_center(self.directory, self.like, step=step)
+
+
 class ServeServer:
     def __init__(self, engine: DecodeEngine, *, host: str = "127.0.0.1",
                  port: int = 0, max_queue: int = 32,
                  default_max_new: int = 32, frame_timeout: float = 5.0,
-                 idle_wait: float = 0.05, drain_timeout: float = 30.0):
+                 idle_wait: float = 0.05, drain_timeout: float = 30.0,
+                 ckpt_dir: str | None = None, ckpt_poll: float = 0.25,
+                 ckpt_like=None, epoch: int | None = None):
         self.engine = engine
         self.sched = Scheduler(engine, max_queue=max_queue)
         self.default_max_new = int(default_max_new)
@@ -91,6 +154,27 @@ class ServeServer:
             labels=("outcome",))
         self._c_toks = obs.counter(
             "serve_tokens_total", "tokens streamed to clients")
+        #: epoch of the params being served (None until known); bumped
+        #: by the tailer from checkpoint metadata ("epoch" key, falling
+        #: back to the step for plain params checkpoints).  epoch /
+        #: ckpt_step / _swap_pending are written only by the serve loop;
+        #: health() readers on other threads take GIL-atomic snapshots
+        #: of int/tuple attributes — a probe racing a swap sees either
+        #: epoch, both valid ("telemetry tolerates a torn view").
+        self.epoch = epoch
+        self.ckpt_step: int | None = None
+        self._tailer = (WeightTailer(ckpt_dir,
+                                     engine.params if ckpt_like is None
+                                     else ckpt_like, poll=ckpt_poll)
+                        if ckpt_dir else None)
+        self._swap_pending: tuple | None = None   # (params, meta) loaded
+        self._c_swaps = obs.counter(
+            "serve_weight_swaps_total",
+            "hot weight swaps applied between ticks")
+        self._g_epoch = obs.gauge(
+            "serve_center_epoch", "center epoch of the params being served")
+        if epoch is not None:
+            self._g_epoch.set(epoch)
         obs.set_health_source(self.health)
 
     # -- health / introspection --------------------------------------------
@@ -100,7 +184,10 @@ class ServeServer:
                 "draining": self._draining,
                 "queue_depth": self.sched.queue_depth(),
                 "active": self.sched.active_count(),
-                "free_pages": self.engine.cache.free_pages()}
+                "free_pages": self.engine.cache.free_pages(),
+                "epoch": self.epoch,
+                "ckpt_step": self.ckpt_step,
+                "swap_pending": self._swap_pending is not None}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServeServer":
@@ -140,6 +227,7 @@ class ServeServer:
             while not self._stop.is_set():
                 try:
                     self._poll_io()
+                    self._maybe_swap()
                     events = self.sched.step()
                     self._dispatch(events)
                     self._g_queue.set(self.sched.queue_depth())
@@ -162,6 +250,43 @@ class ServeServer:
             self._drained.set()
             self._g_queue.set(0)
             self._g_active.set(0)
+
+    def _maybe_swap(self):
+        """Epoch-fenced hot weight swap, between ticks.  Two phases: on
+        a new checkpoint, raise the admissions hold (queued requests
+        wait, nothing new prefills); once the active set drains, install
+        the new params and release the hold.  In-flight streams thus
+        finish entirely under their admission epoch and every stream
+        admitted after the swap runs entirely under the new one — no
+        stream ever observes two epochs (the 'R'-chunk echo that
+        ``serve.router`` fences on).  The wait is bounded by the longest
+        in-flight ``max_new`` budget, never a queue's worth."""
+        if self._tailer is None:
+            return
+        if self._swap_pending is None:
+            got = self._tailer.maybe_load(time.monotonic())
+            if got is None:
+                return
+            self._swap_pending = got
+            self.sched.hold = True
+        if self.sched.active_count():
+            return                      # old-epoch streams still decoding
+        tree, meta = self._swap_pending
+        self._swap_pending = None
+        self.sched.hold = False
+        try:
+            self.engine.swap_params(tree)
+        except ValueError as e:
+            # layout drift (wrong depth/shape): refuse the swap, keep
+            # serving the old weights — availability over freshness.
+            print_server(f"hot swap refused: {e}")
+            return
+        self.ckpt_step = meta.get("step")
+        self.epoch = int(meta.get("epoch", self.ckpt_step or 0))
+        self._c_swaps.inc()
+        self._g_epoch.set(self.epoch)
+        print_server(f"hot-swapped params (step {self.ckpt_step}, "
+                     f"epoch {self.epoch})")
 
     def _poll_io(self):
         self._lst.prune_closed()
@@ -236,7 +361,10 @@ class ServeServer:
         rid = str(msg.get("rid") or "")
         try:
             if self._draining:
-                raise QueueFull("server draining")
+                # no retry_after: a draining server never admits again —
+                # the client/router should go elsewhere, not wait here.
+                raise QueueFull("server draining",
+                                queue_depth=self.sched.queue_depth())
             prompt = np.asarray(msg["prompt"], np.int32)
             rid = self.sched.submit(
                 prompt, int(msg.get("max_new", self.default_max_new)),
@@ -245,9 +373,16 @@ class ServeServer:
                 eos=msg.get("eos"))
         except (QueueFull, ValueError, KeyError, TypeError) as e:
             self._c_reqs.labels(outcome="rejected").inc()
+            chunk = {"rid": rid, "error": str(e) or type(e).__name__,
+                     "done": True, "epoch": self.epoch}
+            if isinstance(e, QueueFull):
+                chunk["queue_depth"] = (
+                    e.queue_depth if e.queue_depth is not None
+                    else self.sched.queue_depth())
+                if e.retry_after is not None:
+                    chunk["retry_after"] = e.retry_after
             try:
-                conn.send_stream({"rid": rid, "error": str(e) or type(e).__name__,
-                                  "done": True})
+                conn.send_stream(chunk)
             except OSError:
                 self._drop_conn(conn)
             return
@@ -255,13 +390,17 @@ class ServeServer:
         self._t_submit[rid] = time.perf_counter()
 
     def _dispatch(self, events):
-        # one 'R' frame per request per round: {"rid", "tokens", "done"[,
-        # "reason"]} — streaming granularity is the tick, matching TTFT.
+        # one 'R' frame per request per round: {"rid", "tokens", "epoch",
+        # "done"[, "reason"]} — streaming granularity is the tick,
+        # matching TTFT.  The epoch echo is the hot-swap fence witness:
+        # swaps only happen with zero active streams, so every chunk of
+        # one stream carries the same value.
         out: dict[str, dict] = {}
         now = time.perf_counter()
         for ev in events:
             chunk = out.setdefault(ev.rid, {"rid": ev.rid, "tokens": [],
-                                            "done": False})
+                                            "done": False,
+                                            "epoch": self.epoch})
             if ev.kind == "token":
                 chunk["tokens"].append(ev.token)
                 self._c_toks.inc()

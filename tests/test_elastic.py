@@ -146,6 +146,61 @@ def test_membership_mutations_each_caught_by_exactly_their_rule(
     assert sorted({f.rule for f in rep.findings}) == [rule]
 
 
+# ------------------------------ joiner failover via the join-ACK roster
+
+@pytest.mark.chaos
+def test_joiner_survives_center_kill_via_join_ack_roster(tmp_path):
+    """A Join?-admitted client never saw ``--centers`` on any command
+    line — its failover dial list arrives in the join ACK.  Kill the
+    primary and promote the advertised standby: the joiner re-enters
+    through a fresh Join? under a new cid (its ephemeral dedicated
+    listener died with the primary) and keeps syncing alongside the
+    founding clients' Rejoin? failover."""
+    from distlearn_tpu.parallel import ha
+    from distlearn_tpu.parallel.async_ea import (AsyncEAClient,
+                                                 AsyncEAServerConcurrent)
+
+    host = "127.0.0.1"
+    base = chaos._params()
+    win_a = chaos._reserve_window(8, host)
+    win_b = chaos._reserve_window(8, host)
+    srv, clients, ps = chaos._spawn_fleet(
+        host, win_a, 2, 1, ["raw"], False, [(host, win_b)], base,
+        elastic=True, server_centers=[(host, win_b)])
+    joiner = None
+    try:
+        srv.enable_checkpoint(str(tmp_path), every=1)
+        for r in range(2):
+            for i, cl in enumerate(clients):
+                ps[i] = chaos._drift(ps[i], r)
+                ps[i], _ = cl.sync_client(ps[i])
+        joiner, pj = AsyncEAClient.join(host, win_a, chaos._params(),
+                                        1, 0.5, sharded=False)
+        # the ACK roster, not a flag, armed the joiner's failover()
+        assert (host, win_b) in joiner._centers
+        pj, _ = joiner.sync_client(chaos._drift(pj, 0))
+        chaos._settle_fleet(clients + [joiner], srv)
+        srv.checkpoint_now(wait=True)
+        srv.stop(deadline=2.0)
+        srv.close()
+        srv = AsyncEAServerConcurrent(host, win_b, num_nodes=2, shards=1,
+                                      handshake_timeout=5.0,
+                                      rejoin_grace=60.0, standby=True,
+                                      elastic=True)
+        ha.promote(srv, str(tmp_path), base)
+        srv.start()
+        pj = chaos._sync_with_failover(joiner, chaos._drift(pj, 1))
+        # re-entry was a fresh Join? (ephemeral dedicated port), not a
+        # Rejoin? under the dead primary's roster
+        assert joiner._ded_port is not None
+        for i, cl in enumerate(clients):
+            ps[i] = chaos._sync_with_failover(cl, chaos._drift(ps[i], 2))
+        chaos._settle_fleet(clients + [joiner], srv)
+        assert joiner.node in srv.members
+    finally:
+        chaos._teardown(clients + ([joiner] if joiner else []), srv)
+
+
 # ------------------------------------------- diststat membership table
 
 def _fam(name, value, kind="counter", labels=None, labelnames=()):

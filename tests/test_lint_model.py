@@ -17,7 +17,8 @@ import pytest
 from distlearn_tpu.lint.model import (ModelSpec, builtin_models, check_model,
                                       failover_model, lint_models,
                                       membership_model, replay_model,
-                                      serve_model, sharded_model, sync_model)
+                                      router_model, serve_model,
+                                      sharded_model, sync_model)
 
 pytestmark = pytest.mark.model
 
@@ -31,7 +32,8 @@ def _rules(findings):
 def test_builtin_models_all_clean_and_exhaustive():
     reports = lint_models()
     assert [spec.name for _rep, spec in reports] == [
-        "sync", "sharded", "replay", "failover", "serve", "membership"]
+        "sync", "sharded", "replay", "failover", "serve", "membership",
+        "router"]
     for rep, spec in reports:
         assert rep.findings == [], (
             f"{spec.name}: " + "; ".join(map(str, rep.findings)))
@@ -107,6 +109,31 @@ def test_dl304_membership_without_renorm_breaks_weight_budget():
     rep = check_model(membership_model(renorm=False))
     assert _rules(rep.findings) == ["DL304"]
     assert "budget" in rep.findings[0].message
+
+
+def test_dl301_router_without_retry_strands_the_request():
+    """Strip retry-on-death: a request queued on a replica that dies
+    before prefill has no owner and no resubmission — the request
+    never reaches a terminal state."""
+    rep = check_model(router_model(retry=False))
+    assert _rules(rep.findings) == ["DL301"]
+
+
+def test_dl302_router_without_epoch_fence_mixes_epochs():
+    """Remove the fence: a stream that pinned epoch 0 can deliver a
+    chunk decoded under the hot-swapped epoch-1 weights — two model
+    versions spliced into one completion."""
+    rep = check_model(router_model(fence=False))
+    assert _rules(rep.findings) == ["DL302"]
+    assert "counterexample" in rep.findings[0].message
+
+
+def test_dl303_router_hedge_without_cancel_double_executes():
+    """Hedge WITHOUT closing the first connection: the abandoned copy
+    stays queued on the old replica while the hedge enqueues a second —
+    execution is no longer at-most-once per request."""
+    rep = check_model(router_model(single_dispatch=False))
+    assert _rules(rep.findings) == ["DL303"]
 
 
 def test_mutated_models_stay_clean_when_unmutated():

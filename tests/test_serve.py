@@ -637,3 +637,310 @@ def test_serve_soak_churny_arrival(lm_params):
     assert threading.active_count() <= th_base, "leaked threads"
     assert _gauge_value("serve_queue_depth") == 0
     assert _gauge_value("serve_active_slots") == 0
+
+
+# -- client failure classification (serve.client) -----------------------------
+
+def test_client_dial_deadline_raises_replicadead():
+    """Nothing listening: the dial exhausts its deadline and surfaces
+    the typed death, not a raw ConnectionError after 60 retries."""
+    import socket
+    from distlearn_tpu.serve import ReplicaDead, ServeClient
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(ReplicaDead):
+        ServeClient("127.0.0.1", port, retries=1000, deadline_s=0.3)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_client_stream_timeout_when_server_never_answers(lm_params):
+    """The request loop never runs (server constructed, not started):
+    the stream read must give up at the caller's timeout, not hang."""
+    from distlearn_tpu.serve import DecodeEngine, ServeClient, ServeServer
+    eng = DecodeEngine(lm_params, num_slots=2, max_len=MAX_LEN, page=8)
+    srv = ServeServer(eng, idle_wait=0.01)     # no loop: TCP backlog only
+    try:
+        with ServeClient(srv.host, srv.port) as c:
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError):
+                c.generate([1, 2, 3], 4, timeout=0.3)
+            assert time.monotonic() - t0 < 10.0
+    finally:
+        srv.stop()
+
+
+def test_client_half_sent_chunk_is_replica_death():
+    """A 'R' frame whose payload is cut by a FIN is a torn frame, not a
+    clean goodbye — classified ReplicaDead so the router retries it."""
+    import struct
+    from distlearn_tpu.comm import transport
+    from distlearn_tpu.serve import ReplicaDead, ServeClient
+    lst = transport.Server()
+    try:
+        c = ServeClient(lst.host, lst.port)
+        (sc,) = lst.accept(1, timeout=5.0)
+
+        def feed():
+            kind, _msg = sc.recv_serve(deadline=time.monotonic() + 10)
+            assert kind == "G"
+            sc.sock.sendall(struct.pack("<BQ", ord("R"), 64)
+                            + b'{"rid": "x"')  # 11 of 64 payload bytes
+            sc.sock.close()
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        with pytest.raises(ReplicaDead, match="mid-stream"):
+            c.generate([1, 2, 3], 4, rid="x", timeout=10.0)
+        t.join(10)
+        c.close()
+    finally:
+        lst.close()
+
+
+def test_client_server_death_mid_stream_is_replica_death(lm_params):
+    """The server dies after tokens flowed: the typed death tells the
+    caller how much output it already holds (and the router knows NOT
+    to resubmit)."""
+    from distlearn_tpu.serve import ReplicaDead, ServeClient
+    srv = _serve_server(lm_params)
+    try:
+        with ServeClient(srv.host, srv.port) as c:
+            with pytest.raises(ReplicaDead, match="mid-stream"):
+                c.generate(_prompts(1, seed=23)[0], 30, rid="die",
+                           on_chunk=lambda toks: srv.stop(), timeout=30)
+    finally:
+        srv.stop()
+
+
+def test_client_sees_drain_and_unretryable_rejection(lm_params):
+    """While checkpoint_now drains in-flight work: health says draining,
+    and a new submission is refused with queue_depth but NO retry_after
+    — 'don't retry here, dial another replica' (what the router does)."""
+    from distlearn_tpu.serve import ServeClient, ServeError
+    p = _prompts(1, seed=29)[0]
+    srv = _serve_server(lm_params)
+    orig_tick = srv.engine.tick
+    srv.engine.tick = lambda *a, **kw: (time.sleep(0.02), orig_tick())[1]
+    out = {}
+    try:
+        def run():
+            with ServeClient(srv.host, srv.port) as c:
+                out["r"] = c.generate(p, 50, rid="long", timeout=60)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.monotonic() + 30
+        while srv.sched.active_count() == 0:
+            assert time.monotonic() < deadline, "request never prefilled"
+            time.sleep(0.005)
+        drainer = threading.Thread(
+            target=lambda: srv.checkpoint_now(wait=True))
+        drainer.start()
+        deadline = time.monotonic() + 10
+        while not srv._draining:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        with ServeClient(srv.host, srv.port) as probe:
+            assert probe.ping()["draining"]
+            with pytest.raises(ServeError, match="draining") as ei:
+                probe.generate(p, 4, rid="late", timeout=10)
+            assert ei.value.retry_after is None
+            assert ei.value.queue_depth is not None
+        t.join(60)
+        drainer.join(60)
+        assert not t.is_alive() and not drainer.is_alive()
+        assert out["r"]["reason"] == "complete"   # drained, not cut
+        assert len(out["r"]["tokens"]) == 50
+    finally:
+        srv.stop()
+
+
+def test_queue_full_rejection_carries_depth_and_hint(lm_params):
+    """The overflow rejection chunk tells the client how loaded the
+    replica is (queue_depth) and when to come back (retry_after) —
+    driven synchronously so the overflow window is deterministic."""
+    from distlearn_tpu.comm import transport
+    from distlearn_tpu.serve import DecodeEngine, ServeServer
+    eng = DecodeEngine(lm_params, num_slots=1, max_len=MAX_LEN, page=8)
+    srv = ServeServer(eng, idle_wait=0.01, max_queue=1)  # test pumps
+    conns = []
+    try:
+        p = _prompts(1, seed=31)[0]
+        for i in range(3):
+            c = transport.connect(srv.host, srv.port)
+            conns.append(c)
+            c.send_gen({"prompt": p.tolist(), "max_new": 4,
+                        "rid": f"q{i}"})
+        # io-only rounds (no sched.step): one request queues, the other
+        # two overflow the depth-1 queue and get rejection chunks back
+        deadline = time.monotonic() + 30
+        rejects = []
+        while len(rejects) < 2:
+            assert time.monotonic() < deadline, "rejections never arrived"
+            srv._poll_io()
+            for c in conns:
+                for kind, chunk in c.recv_serve_nowait():
+                    rejects.append((kind, chunk))
+        assert srv.sched.queue_depth() == 1
+        for kind, chunk in rejects:
+            assert kind == "R" and chunk["done"]
+            assert "capacity" in chunk["error"]
+            assert chunk["queue_depth"] == 1
+            assert chunk["retry_after"] > 0
+            assert "epoch" in chunk
+    finally:
+        for c in conns:
+            c.close()
+        srv.stop()
+
+
+def test_client_shed_retry_honors_hint():
+    """generate() backs off on a retry_after rejection and retries the
+    same connection; the transient never surfaces.  With retries
+    disabled the shed surfaces typed, hint attached."""
+    from distlearn_tpu.comm import transport
+    from distlearn_tpu.serve import ReplicaDead, ServeClient, ServeError
+    lst = transport.Server()
+    seen = []
+
+    def script():
+        from distlearn_tpu.comm.errors import PeerClosed
+        (sc,) = lst.accept(1, timeout=10.0)
+        for _ in range(2):
+            try:
+                kind, msg = sc.recv_serve(deadline=time.monotonic() + 10)
+            except PeerClosed:
+                return          # client gave up after the shed (retries=0)
+            assert kind == "G"
+            seen.append(time.monotonic())
+            if len(seen) == 1:
+                sc.send_stream({"rid": msg["rid"], "done": True,
+                                "error": "admission queue at capacity",
+                                "queue_depth": 2, "retry_after": 0.05,
+                                "epoch": 7})
+            else:
+                sc.send_stream({"rid": msg["rid"], "tokens": [4, 2],
+                                "done": True, "reason": "complete",
+                                "epoch": 7})
+
+    t = threading.Thread(target=script, daemon=True)
+    t.start()
+    try:
+        with ServeClient(lst.host, lst.port) as c:
+            r = c.generate([1, 2, 3], 2, rid="s", shed_retries=3)
+        assert r["tokens"] == [4, 2] and r["epoch"] == 7
+        assert len(seen) == 2              # shed once, retried once
+        t.join(10)
+        # retries disabled: the shed surfaces with its hint
+        seen.clear()
+        t2 = threading.Thread(target=script, daemon=True)
+        t2.start()
+        with ServeClient(lst.host, lst.port) as c:
+            with pytest.raises(ServeError) as ei:
+                c.generate([1, 2, 3], 2, rid="s", shed_retries=0)
+            assert not isinstance(ei.value, ReplicaDead)
+            assert ei.value.retry_after == pytest.approx(0.05)
+            assert ei.value.queue_depth == 2
+    finally:
+        lst.close()
+
+
+# -- hot weight swap (engine.swap_params + WeightTailer) ----------------------
+
+def test_engine_swap_params_parity_and_validation(lm_params):
+    """A valid swap re-binds the SAME compiled programs to new leaves:
+    decode after the swap is token-identical to greedy_generate under
+    the new params.  Layout drift (depth or leaf shape) is refused."""
+    import jax
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.serve import DecodeEngine
+    eng = DecodeEngine(lm_params, num_slots=1, max_len=MAX_LEN, page=8)
+    p = _prompts(1, seed=33)[0]
+    new_params = jax.tree_util.tree_map(lambda a: a + 0.01, lm_params)
+    ref_new = _greedy_ref(new_params, p, 5)
+    eng.swap_params(new_params)
+    slot, first = eng.admit(p, 5)
+    toks = [first]
+    while len(toks) < 5:
+        got = eng.tick()
+        if slot in got:
+            toks.append(got[slot])
+    eng.finish(slot)
+    assert toks == ref_new
+    shallow_model = transformer_lm(vocab=VOCAB, dim=DIM, depth=1,
+                                   heads=HEADS, max_len=MAX_LEN)
+    shallow, _ = shallow_model.init(jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="depth"):
+        eng.swap_params(shallow)
+    thin_model = transformer_lm(vocab=VOCAB, dim=16, depth=DEPTH,
+                                heads=4, max_len=MAX_LEN)
+    thin, _ = thin_model.init(jax.random.PRNGKey(2))
+    with pytest.raises(ValueError, match="mismatch|structure"):
+        eng.swap_params(thin)
+
+
+def test_hot_swap_epoch_fenced_e2e(lm_params, tmp_path):
+    """A checkpoint landing in the tailed directory swaps between ticks:
+    pre-swap streams echo epoch 1, post-swap streams echo epoch 2 with
+    token parity against the NEW weights, and health reports the new
+    epoch/step."""
+    import jax
+    from distlearn_tpu.serve import ServeClient
+    from distlearn_tpu.utils.checkpoint import save_checkpoint
+    new_params = jax.tree_util.tree_map(lambda a: a + 0.01, lm_params)
+    srv = _serve_server(lm_params, ckpt_dir=str(tmp_path), ckpt_poll=0.01,
+                        epoch=1)
+    p = _prompts(1, seed=37)[0]
+    try:
+        with ServeClient(srv.host, srv.port) as c:
+            r1 = c.generate(p, 5, rid="pre")
+        assert r1["epoch"] == 1
+        assert r1["tokens"] == _greedy_ref(lm_params, p, 5)
+        save_checkpoint(str(tmp_path), 7, new_params,
+                        metadata={"epoch": 2})
+        deadline = time.monotonic() + 30
+        while srv.epoch != 2:
+            assert time.monotonic() < deadline, "swap never landed"
+            time.sleep(0.01)
+        assert srv.ckpt_step == 7
+        h = srv.health()
+        assert h["epoch"] == 2 and not h["swap_pending"]
+        with ServeClient(srv.host, srv.port) as c:
+            r2 = c.generate(p, 5, rid="post")
+        assert r2["epoch"] == 2
+        assert r2["tokens"] == _greedy_ref(new_params, p, 5)
+    finally:
+        srv.stop()
+
+
+def test_hot_swap_skips_foreign_checkpoint_and_keeps_serving(lm_params,
+                                                            tmp_path):
+    """A checkpoint that doesn't restore against the serving layout is
+    skipped with a warning — availability over freshness: the old
+    weights and epoch keep serving."""
+    import jax
+    from distlearn_tpu.models.transformer import transformer_lm
+    from distlearn_tpu.serve import ServeClient
+    from distlearn_tpu.utils.checkpoint import save_checkpoint
+    thin_model = transformer_lm(vocab=VOCAB, dim=16, depth=DEPTH,
+                                heads=4, max_len=MAX_LEN)
+    thin, _ = thin_model.init(jax.random.PRNGKey(1))
+    srv = _serve_server(lm_params, ckpt_dir=str(tmp_path), ckpt_poll=0.01,
+                        epoch=1)
+    p = _prompts(1, seed=41)[0]
+    try:
+        save_checkpoint(str(tmp_path), 1, thin, metadata={"epoch": 9})
+        deadline = time.monotonic() + 10
+        while srv._tailer._warned_step != 1:   # tailer saw and skipped it
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        assert srv.epoch == 1                  # refused, not adopted
+        with ServeClient(srv.host, srv.port) as c:
+            r = c.generate(p, 5, rid="still")
+        assert r["epoch"] == 1
+        assert r["tokens"] == _greedy_ref(lm_params, p, 5)
+    finally:
+        srv.stop()

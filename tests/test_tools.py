@@ -160,9 +160,10 @@ def test_distlint_model_and_races_flags(capsys):
     doc = _json.loads(capsys.readouterr().out)
     assert set(doc) == {"findings", "costs", "info", "units", "errors"}
     assert doc["findings"] == [] and doc["errors"] == 0
-    assert doc["units"] == 8
+    assert doc["units"] == 9
     for unit in ("model:sync", "model:sharded", "model:replay",
-                 "model:failover", "model:serve", "model:membership"):
+                 "model:failover", "model:serve", "model:membership",
+                 "model:router"):
         assert doc["info"][unit]["states"] > 0
         assert doc["info"][unit]["transitions"] > 0
 

@@ -50,6 +50,22 @@ named, seeded scenarios over the comm-layer fault-injection plan
   link heals, and the rejoin replay must land the blackholed delta
   EXACTLY once — asserted bitwise against the unkilled reference.
 
+Four more scenarios drive the SERVING fleet (docs/SERVING.md): a
+``serve.Router`` over shared-nothing ``ServeServer`` replicas —
+
+* ``replica_kill``        — kill 1 of 3 replicas mid-wave; every
+  accepted request must end in a terminal result (resubmitted to a
+  survivor or a clean partial ``failed``), and the post-kill fleet
+  keeps serving;
+* ``slow_replica``        — a straggler replica stalls prefill; hedged
+  requests must cancel there and complete on the healthy one;
+* ``overload_shed``       — a saturated fleet refuses with RouterBusy +
+  ``retry_after`` at both the router watermark and the replica's
+  QueueFull, then admits again once drained;
+* ``swap_during_traffic`` — an epoch-2 checkpoint lands under load;
+  zero failed streams, zero fence violations, no stream observes two
+  epochs.
+
 Settle/recovery budgets honor ``DISTLEARN_CHAOS_SETTLE_S`` and
 ``DISTLEARN_CHAOS_RECOVER_S`` (seconds) for slow CI machines.
 
@@ -227,9 +243,11 @@ def _settle_fleet(clients, srv, timeout: float | None = None) -> None:
 def _spawn_fleet(host, port, num_clients, shards, codecs, overlap,
                  centers, params, handshake_timeout=5.0,
                  rejoin_grace=60.0, elastic=False, tau=1, alpha=0.5,
-                 adaptive_tau=False):
+                 adaptive_tau=False, server_centers=None):
     """Server + clients, concurrently (both constructors block on the
-    accept/dial handshake).  Returns (server, [clients], [params])."""
+    accept/dial handshake).  Returns (server, [clients], [params]).
+    ``server_centers`` is the HA roster the server advertises in Join
+    ACKs so Join?-admitted clients can failover() too."""
     box: dict = {}
 
     def _dial(i):
@@ -248,7 +266,8 @@ def _spawn_fleet(host, port, num_clients, shards, codecs, overlap,
     srv = AsyncEAServerConcurrent(
         host, port, num_nodes=num_clients, shards=shards,
         accept_timeout=60.0, handshake_timeout=handshake_timeout,
-        rejoin_grace=rejoin_grace, elastic=elastic)
+        rejoin_grace=rejoin_grace, elastic=elastic,
+        centers=server_centers)
     for t in threads:
         t.join(timeout=60.0)
     clients = []
@@ -841,20 +860,395 @@ def _scenario_partition_heal(rounds, seed, host):
             "rejoins": totals.get("async_ea_rejoins_total", 0)}, failures
 
 
+# ---------------------------------------------------------------------------
+# Serving-fleet scenario driver (docs/SERVING.md): a Router over N
+# shared-nothing ServeServer replicas under client load while faults land.
+
+_SERVE_LM = {"vocab": 61, "dim": 32, "depth": 2, "heads": 4, "max_len": 64}
+
+
+def _lm_params():
+    import jax
+    from distlearn_tpu.models.transformer import transformer_lm
+    model = transformer_lm(**_SERVE_LM)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    return params
+
+
+def _serve_prompts(n, seed):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, _SERVE_LM["vocab"],
+                         size=int(rng.integers(3, 9))).astype(np.int32)
+            for _ in range(n)]
+
+
+def _spawn_replicas(host, port, n, params, *, num_slots=2, **server_kw):
+    """N independent single-process replicas on consecutive ports, each
+    with its own engine and KV cache (shared-nothing, like the real
+    fleet — only the checkpoint directory may be shared)."""
+    from distlearn_tpu.serve.engine import DecodeEngine
+    from distlearn_tpu.serve.server import ServeServer
+    servers = []
+    for i in range(n):
+        eng = DecodeEngine(params, num_slots=num_slots,
+                           max_len=_SERVE_LM["max_len"], page=8)
+        servers.append(ServeServer(eng, host=host, port=port + i,
+                                   idle_wait=0.005, **server_kw).start())
+    return servers
+
+
+def _stop_replicas(servers):
+    for srv in servers:
+        try:
+            srv.stop()
+        except OSError:
+            pass
+
+
+def _fleet_load(router, prompts, max_new, *, stagger=0.0, timeout=None,
+                on_index=None):
+    """One ``router.generate`` per prompt from worker threads (launch
+    staggered from the driver thread), collecting a result-or-exception
+    per request.  ``on_index(i)`` runs in the driver thread just before
+    request ``i`` launches — the scenario's fault hook.  Returns
+    ``(results, hung)`` where ``hung`` counts threads that outlived the
+    recovery budget (always a failure)."""
+    timeout = CHAOS_RECOVER_S if timeout is None else timeout
+    out: list = [None] * len(prompts)
+
+    def _one(i):
+        try:
+            out[i] = router.generate(prompts[i], max_new, rid=f"q{i}",
+                                     timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — classified by the caller
+            out[i] = e
+
+    threads = []
+    for i in range(len(prompts)):
+        if on_index is not None:
+            on_index(i)
+        t = threading.Thread(target=_one, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+        if stagger:
+            time.sleep(stagger)
+    for t in threads:
+        t.join(timeout=CHAOS_RECOVER_S)
+    return out, sum(1 for t in threads if t.is_alive())
+
+
+def _scenario_replica_kill(rounds, seed, host):
+    """Kill 1 of 3 replicas under a staggered request wave.  Every
+    accepted request must end in a terminal result: queued-not-yet-
+    prefilled requests resubmitted to survivors (``router_retries_total``),
+    mid-stream deaths surfaced as clean ``reason="failed"`` with the
+    partial tokens — never a hang or an unclassified error.  The
+    post-kill fleet must keep completing fresh requests on the two
+    survivors."""
+    from distlearn_tpu.serve.router import Router
+    params = _lm_params()
+    port = _reserve_window(3, host)
+    servers = _spawn_replicas(host, port, 3, params)
+    total = rounds * 3
+    kill_at = total // 2
+    try:
+        with Router([(host, port + i) for i in range(3)],
+                    health_ttl=0.05, retry_interval=0.02,
+                    dial_deadline=1.0) as router:
+
+            def _fault(i):
+                if i == kill_at:
+                    servers[0].stop()       # hard kill: sockets cut
+
+            results, hung = _fleet_load(
+                router, _serve_prompts(total, seed), 4,
+                stagger=0.02, on_index=_fault)
+            post, hung_post = _fleet_load(
+                router, _serve_prompts(6, seed + 1), 4)
+    finally:
+        _stop_replicas(servers)
+    snap = core.REGISTRY.snapshot()
+    retries = sum(_labeled(snap, "router_retries_total").values())
+    dispatched = _labeled(snap, "router_dispatch_total")
+    done = [r for r in results
+            if isinstance(r, dict) and r["reason"] in ("complete", "eos")]
+    failed = [r for r in results
+              if isinstance(r, dict) and r["reason"] == "failed"]
+    errs = [r for r in results if not isinstance(r, dict)]
+    failures = []
+    if hung or hung_post:
+        failures.append(f"{hung + hung_post} request thread(s) hung past "
+                        "the recovery budget")
+    if errs:
+        failures.append(f"{len(errs)} request(s) raised instead of ending "
+                        f"in a terminal result: {errs[:3]!r}")
+    if len(done) + len(failed) != total:
+        failures.append(f"terminal results {len(done)}+{len(failed)} != "
+                        f"accepted {total}")
+    if any(len(r["tokens"]) != 4 for r in done):
+        failures.append("a completed stream delivered a short token count")
+    if retries + len(failed) < 1:
+        failures.append("the kill was never observed: no resubmission and "
+                        "no mid-stream failure")
+    if len(dispatched) < 2:
+        failures.append("load never spread past one replica")
+    bad_post = [r for r in post
+                if not (isinstance(r, dict) and r["reason"] == "complete")]
+    if bad_post:
+        failures.append(f"post-kill fleet dropped {len(bad_post)} of "
+                        f"{len(post)} fresh requests: {bad_post[:3]!r}")
+    return {"requests": total, "completed": len(done),
+            "failed_mid_stream": len(failed), "retries": retries,
+            "replicas_dispatched": len(dispatched)}, failures
+
+
+def _scenario_slow_replica(rounds, seed, host):
+    """One of two replicas turns straggler: its prefill path sleeps 0.4s
+    (a replica wedged on compilation/paging — alive, answering probes,
+    producing nothing).  With deadline-aware hedging armed at 0.1s,
+    requests stuck behind it with no first token must cancel there and
+    re-dispatch: every request completes and ``router_hedges_total``
+    fires.  At-most-once holds — the canceled copy decodes into a
+    closed socket, never into the client."""
+    from distlearn_tpu.serve.router import Router
+    params = _lm_params()
+    port = _reserve_window(2, host)
+    servers = _spawn_replicas(host, port, 2, params)
+    slow = servers[0]                       # list head wins score ties
+    orig_admit = slow.engine.admit
+
+    def _slow_admit(*a, **kw):
+        time.sleep(0.4)
+        return orig_admit(*a, **kw)
+
+    slow.engine.admit = _slow_admit
+    try:
+        with Router([(host, port), (host, port + 1)], health_ttl=0.02,
+                    hedge_after=0.1, retry_interval=0.02,
+                    dial_deadline=1.0) as router:
+            results, hung = _fleet_load(
+                router, _serve_prompts(rounds, seed), 4, stagger=0.05)
+    finally:
+        _stop_replicas(servers)
+    snap = core.REGISTRY.snapshot()
+    hedges = sum(_labeled(snap, "router_hedges_total").values())
+    done = [r for r in results
+            if isinstance(r, dict) and r["reason"] == "complete"]
+    failures = []
+    if hung:
+        failures.append(f"{hung} request thread(s) hung")
+    if len(done) != rounds:
+        bad = [r for r in results if r not in done]
+        failures.append(f"only {len(done)}/{rounds} completed: "
+                        f"{bad[:3]!r}")
+    if hedges < 1:
+        failures.append("no hedge fired despite the straggler")
+    fast = f"{host}:{port + 1}"
+    if not any(r.get("replica") == fast for r in done):
+        failures.append("no completion landed on the healthy replica")
+    return {"requests": rounds, "completed": len(done),
+            "hedges": hedges}, failures
+
+
+def _scenario_overload_shed(rounds, seed, host):
+    """Saturate a one-replica fleet with a long slow decode.  Router
+    admission control must refuse new work with ``RouterBusy`` carrying
+    a ``retry_after`` hint (graceful degradation, not a client-side
+    timeout); the replica's own ``QueueFull`` shed must surface through
+    a watermark-less router as RouterBusy too; and once the backlog
+    drains the same fleet must accept work again."""
+    from distlearn_tpu.serve.router import Router, RouterBusy
+    params = _lm_params()
+    port = _reserve_window(1, host)
+    (srv,) = _spawn_replicas(host, port, 1, params, num_slots=1,
+                             max_queue=1)
+    orig_tick = srv.engine.tick
+
+    def _slow_tick(*a, **kw):
+        time.sleep(0.05)                    # ~2.4s for the 48-token run
+        return orig_tick(*a, **kw)
+
+    srv.engine.tick = _slow_tick
+    prompts = _serve_prompts(3, seed)
+    failures: list = []
+    box: dict = {}
+    try:
+        with Router([(host, port)], shed_watermark=1, health_ttl=0.01,
+                    dial_deadline=1.0) as router, \
+             Router([(host, port)], shed_watermark=None, health_ttl=0.01,
+                    dial_deadline=1.0) as bare:
+
+            def _bg(key, rtr, prompt, max_new):
+                def _run():
+                    try:
+                        box[key] = rtr.generate(prompt, max_new, rid=key,
+                                                timeout=CHAOS_RECOVER_S)
+                    except Exception as e:  # noqa: BLE001
+                        box[key] = e
+                t = threading.Thread(target=_run, daemon=True)
+                t.start()
+                return t
+
+            t_long = _bg("long", router, prompts[0], 48)
+            deadline = time.monotonic() + CHAOS_SETTLE_S
+            while time.monotonic() < deadline:
+                h = router.health()
+                if h["queue_depth"] + h["active"] >= 1:
+                    break
+                time.sleep(0.01)
+            else:
+                failures.append("the long request never showed up in "
+                                "fleet health")
+            # router-level shed: aggregate depth is at the watermark
+            sheds = hint = 0
+            for i in range(rounds):
+                try:
+                    router.generate(prompts[1], 4, rid=f"shed{i}",
+                                    timeout=5.0)
+                    failures.append("a request was admitted past the "
+                                    "watermark")
+                except RouterBusy as e:
+                    sheds += 1
+                    hint = e.retry_after
+                    if not e.retry_after or e.retry_after <= 0:
+                        failures.append("RouterBusy without a retry_after "
+                                        "hint")
+            # replica-level shed: fill the depth-1 queue, then the next
+            # submit gets the QueueFull rejection chunk and the
+            # watermark-less router re-raises it as "every replica shed"
+            t_fill = _bg("fill", bare, prompts[2], 4)
+            deadline = time.monotonic() + CHAOS_SETTLE_S
+            while (srv.sched.queue_depth() < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            try:
+                bare.generate(prompts[1], 4, rid="reject", timeout=5.0)
+                failures.append("the replica's QueueFull never surfaced")
+            except RouterBusy as e:
+                if not e.retry_after:
+                    failures.append("replica shed lost its retry_after "
+                                    "hint through the router")
+            t_long.join(CHAOS_RECOVER_S)
+            t_fill.join(CHAOS_RECOVER_S)
+            for key, want in (("long", 48), ("fill", 4)):
+                got = box.get(key)
+                if not (isinstance(got, dict)
+                        and got["reason"] == "complete"
+                        and len(got["tokens"]) == want):
+                    failures.append(f"backlogged request {key!r} did not "
+                                    f"complete: {got!r}")
+            # drained fleet must admit again
+            try:
+                router.generate(prompts[1], 4, rid="after", timeout=30.0)
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"post-drain request failed: {e!r}")
+    finally:
+        _stop_replicas([srv])
+    totals = _totals(core.REGISTRY.snapshot())
+    if totals.get("router_shed_total", 0) < sheds + 1:
+        failures.append("router_shed_total undercounts the sheds")
+    return {"sheds": sheds, "retry_after_hint": hint,
+            "shed_total": totals.get("router_shed_total", 0)}, failures
+
+
+def _scenario_swap_during_traffic(rounds, seed, host):
+    """Epoch-fenced hot weight swap under live traffic: both replicas
+    tail one checkpoint directory; a new center (epoch 2) lands mid-
+    wave.  The fence must hold — zero failed streams, zero fence
+    violations, every stream pinned to exactly one epoch (the 'R'-chunk
+    echo), both replicas converging to epoch 2 and serving post-swap
+    traffic entirely there."""
+    from distlearn_tpu.serve.router import Router
+    from distlearn_tpu.utils.checkpoint import save_checkpoint
+    params = _lm_params()
+    port = _reserve_window(2, host)
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-swap-")
+    servers = _spawn_replicas(host, port, 2, params, ckpt_dir=ckpt_dir,
+                              ckpt_poll=0.02, epoch=1)
+    total = rounds * 2
+    swap_at = total // 3
+    next_params = {}
+    failures: list = []
+    try:
+        import jax
+        next_params = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) * np.float32(0.5), params)
+        with Router([(host, port), (host, port + 1)], health_ttl=0.02,
+                    dial_deadline=1.0) as router:
+
+            def _fault(i):
+                if i == swap_at:
+                    save_checkpoint(ckpt_dir, 1, next_params,
+                                    metadata={"epoch": 2})
+
+            results, hung = _fleet_load(
+                router, _serve_prompts(total, seed), 6,
+                stagger=0.02, on_index=_fault)
+            deadline = time.monotonic() + CHAOS_RECOVER_S
+            while time.monotonic() < deadline:
+                if all(s.epoch == 2 for s in servers):
+                    break
+                time.sleep(0.02)
+            else:
+                failures.append(f"replicas never converged to epoch 2: "
+                                f"{[s.epoch for s in servers]}")
+            post, hung_post = _fleet_load(
+                router, _serve_prompts(4, seed + 1), 4)
+    finally:
+        _stop_replicas(servers)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    totals = _totals(core.REGISTRY.snapshot())
+    swaps = totals.get("serve_weight_swaps_total", 0)
+    fences = totals.get("router_fence_violations_total", 0)
+    done = [r for r in results
+            if isinstance(r, dict) and r["reason"] == "complete"]
+    epochs_seen = sorted({r["epoch"] for r in done})
+    if hung or hung_post:
+        failures.append("request thread(s) hung through the swap")
+    if len(done) != total:
+        bad = [r for r in results if r not in done]
+        failures.append(f"{len(bad)} stream(s) did not complete cleanly "
+                        f"through the swap: {bad[:3]!r}")
+    if fences:
+        failures.append(f"{fences} fence violation(s): a stream observed "
+                        "two epochs")
+    if swaps != 2:
+        failures.append(f"weight swaps {swaps}, want exactly 1 per replica")
+    if not set(epochs_seen) <= {1, 2}:
+        failures.append(f"unknown epochs in streams: {epochs_seen}")
+    if 1 not in epochs_seen:
+        failures.append("no stream completed on the pre-swap epoch "
+                        "(swap landed before traffic?)")
+    bad_post = [r for r in post
+                if not (isinstance(r, dict) and r["reason"] == "complete"
+                        and r["epoch"] == 2)]
+    if bad_post:
+        failures.append(f"post-swap traffic not entirely on epoch 2: "
+                        f"{bad_post[:3]!r}")
+    return {"requests": total, "completed": len(done),
+            "stream_epochs": epochs_seen, "swaps": swaps,
+            "fence_violations": fences}, failures
+
+
 _SCENARIOS = {
     "flash_join": _scenario_flash_join,
     "rolling_leave": _scenario_rolling_leave,
     "slow_node": _scenario_slow_node,
     "partition_heal": _scenario_partition_heal,
+    "replica_kill": _scenario_replica_kill,
+    "slow_replica": _scenario_slow_replica,
+    "overload_shed": _scenario_overload_shed,
+    "swap_during_traffic": _scenario_swap_during_traffic,
 }
 
 
 def run_scenario(name: str, rounds: int = 12, seed: int = 0,
                  host: str = "127.0.0.1") -> dict:
-    """Run one named elastic chaos scenario (see module docstring) and
-    assert its invariants + zero fd/thread leaks.  Deterministically
-    seeded: every injected fault decision flows from ``seed`` through
-    the FaultPlan's per-link RNG streams."""
+    """Run one named chaos scenario (elastic membership or serving
+    fleet — see module docstring) and assert its invariants + zero
+    fd/thread leaks.  Deterministically seeded: every injected fault
+    decision flows from ``seed`` (FaultPlan per-link RNG streams, the
+    request mix of the serve scenarios)."""
     if name not in _SCENARIOS:
         raise ValueError(f"unknown scenario {name!r} "
                          f"(have: {', '.join(sorted(_SCENARIOS))})")
@@ -903,7 +1297,8 @@ def main(argv=None) -> int:
     cp.add_argument("--server-kills", type=int, default=2)
     cp.add_argument("--no-overlap", action="store_true")
     sp = sub.add_parser("scenario",
-                        help="elastic membership chaos scenarios")
+                        help="elastic membership / serving fleet chaos "
+                             "scenarios")
     sp.add_argument("--name", required=True,
                     choices=sorted(_SCENARIOS))
     sp.add_argument("--rounds", type=int, default=12)

@@ -338,6 +338,45 @@ def serving_table(counter_totals: dict, counters: dict, spans: dict) -> dict:
     return tab
 
 
+_ROUTER_TOTALS = {
+    "router_retries_total": "retries",
+    "router_hedges_total": "hedges",
+    "router_shed_total": "sheds",
+    "router_fence_violations_total": "fence_violations",
+}
+_ROUTER_DISPATCH = 'router_dispatch_total{replica="'
+_ROUTER_SPANS = {"router.failover": "failover", "router.hedge": "hedge"}
+
+
+def router_table(counter_totals: dict, counters: dict, spans: dict) -> dict:
+    """Derive the fleet-router table (docs/SERVING.md): per-replica
+    dispatch counts, death resubmissions, hedges, sheds and epoch-fence
+    violations, plus the failover/hedge recovery latency quantiles
+    (replica death or hedge fire to first token on the survivor).
+    Empty when the run had no router in front of it."""
+    tab: dict = {}
+    dispatch = {}
+    for key, v in counters.items():
+        if key.startswith(_ROUTER_DISPATCH) and key.endswith('"}'):
+            dispatch[key[len(_ROUTER_DISPATCH):-2]] = v
+    if dispatch:
+        tab["dispatch"] = dict(sorted(dispatch.items()))
+    for fam, col in _ROUTER_TOTALS.items():
+        v = counter_totals.get(fam, 0)
+        if v:
+            tab[col] = v
+    lat = {}
+    for name, col in _ROUTER_SPANS.items():
+        durs = spans.get(name)
+        if durs:
+            lat[col] = {"count": len(durs),
+                        "p50": _percentile(durs, 50),
+                        "p99": _percentile(durs, 99)}
+    if lat:
+        tab["latency"] = lat
+    return tab
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -368,7 +407,9 @@ def summarize_run(paths: list[str]) -> dict:
                                            run["counters"], run["gauges"],
                                            run["spans"]),
             "serving": serving_table(run["counter_totals"],
-                                     run["counters"], run["spans"])}
+                                     run["counters"], run["spans"]),
+            "router": router_table(run["counter_totals"],
+                                   run["counters"], run["spans"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -519,6 +560,18 @@ def _print_summary(doc: dict):
                           f"{_fmt_s(row['p50']):>10} "
                           f"{_fmt_s(row['p95']):>10} "
                           f"{_fmt_s(row['p99']):>10}")
+        print()
+    if doc.get("router"):
+        rt = doc["router"]
+        print("router:")
+        for replica, v in rt.get("dispatch", {}).items():
+            print(f"  dispatch[{replica}] = {v:g}")
+        for col in ("retries", "hedges", "sheds", "fence_violations"):
+            if col in rt:
+                print(f"  {col} = {rt[col]:g}")
+        for name, row in rt.get("latency", {}).items():
+            print(f"  {name}: count={row['count']} "
+                  f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
 
 
 def _print_diff(doc: dict):
