@@ -16,6 +16,13 @@ Wire protocol (shared with the native C++ backend in src/comm/distcomm.cpp):
     kind 'R': payload is UTF-8 JSON — one token-stream RESPONSE chunk
               (tokens out): {"id", "tokens": [ints], "done", ...}
 
+JSON frames ('J' admission announces, 'G' requests) MAY carry an
+optional "tc" field — the cross-process trace context {"t": trace-id
+hex, "s": parent span-id hex, "f": 0|1} (obs/trace.py, docs/
+OBSERVABILITY.md).  The field only appears when DISTLEARN_TRACE_PROP is
+on; absent, frames are bitwise identical to pre-trace peers', and a
+receiver treats a malformed value as "no trace" — never an error.
+
 Connection management (listen/accept/connect/poll) stays in Python; the
 byte-moving hot path (frame assembly, big-buffer send/recv loops) dispatches
 to the native library when built (distlearn_tpu.comm.native), falling back to

@@ -29,6 +29,12 @@ pins the two together:
   must appear in some schedule, except those in
   :data:`KNOWN_UNMODELED` (with a recorded reason), so a NEW message
   type cannot ship without either a schedule or a conscious exemption.
+* **Trace-context field** — the optional cross-process trace context
+  (docs/OBSERVABILITY.md) rides dict messages under
+  ``obs.trace.TRACE_KEY``; its value is pinned to ``"tc"`` (renaming it
+  breaks mixed-fleet interop with peers already on the wire) and
+  ``async_ea.py`` must show usage evidence — the ``_announce`` stamp
+  and the ``_admit`` adoption read the constant, not a literal.
 
 ``lint_conformance(schedules=..., source=...)`` accepts overrides so the
 seeded-mutation tests can feed in an edited schedule or edited module
@@ -103,6 +109,9 @@ class _CodeFacts(ast.NodeVisitor):
     def __init__(self):
         self.consts: dict[str, object] = {}
         self.loads: dict[str, int] = {}
+        #: attribute-name -> Load count (``obs_trace.TRACE_KEY`` reads
+        #: are Attribute nodes, invisible to the Name counter above)
+        self.attr_loads: dict[str, int] = {}
         #: function name -> ordered list of send descriptors:
         #:   ("const", NAME) for send_msg(NAME)
         #:   ("keys", frozenset) for send_msg({...literal dict...})
@@ -128,6 +137,12 @@ class _CodeFacts(ast.NodeVisitor):
     def visit_Name(self, node):
         if isinstance(node.ctx, ast.Load):
             self.loads[node.id] = self.loads.get(node.id, 0) + 1
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.attr_loads[node.attr] = \
+                self.attr_loads.get(node.attr, 0) + 1
         self.generic_visit(node)
 
     def _record_send(self, desc):
@@ -255,7 +270,10 @@ def lint_conformance(*, schedules: Mapping | None = None,
             "from the code (_refuse_stale)", where="async_ea._refuse_stale"))
 
     # -- 4. question order: Center? before delta? ---------------------------
-    client_sends = [c for k, c in facts.sends.get("sync_client", ())
+    # sync_client is a thin tau/trace gate around _sync_once, which owns
+    # the round's wire traffic — scan both so the split stays honest
+    client_sends = [c for fname in ("sync_client", "_sync_once")
+                    for k, c in facts.sends.get(fname, ())
                     if k == "const"]
     code_order_ok = ("CENTER_Q" in client_sends and "DELTA_Q" in client_sends
                      and (client_sends.index("CENTER_Q")
@@ -290,4 +308,25 @@ def lint_conformance(*, schedules: Mapping | None = None,
                 f"it and no KNOWN_UNMODELED exemption — new wire traffic "
                 f"must be modeled or consciously exempted",
                 where=f"async_ea.{name}"))
+
+    # -- 6. trace-context frame field (docs/OBSERVABILITY.md) ---------------
+    # The optional trace context rides dict messages under TRACE_KEY;
+    # the documented wire format (and mixed-fleet interop) pins the key
+    # to "tc", and async_ea.py must actually stamp/read it — a schedule
+    # can't model an optional field, so the binding is evidence-only.
+    from distlearn_tpu.obs import trace as _obs_trace
+    if _obs_trace.TRACE_KEY != "tc":
+        findings.append(Finding(
+            "DL310",
+            f"obs.trace.TRACE_KEY is {_obs_trace.TRACE_KEY!r} but the "
+            f"documented wire format pins 'tc' — peers already in "
+            f"flight would silently drop the renamed field",
+            where="obs.trace.TRACE_KEY"))
+    if facts.attr_loads.get("TRACE_KEY", 0) < 1:
+        findings.append(Finding(
+            "DL310",
+            "async_ea.py never reads obs.trace.TRACE_KEY — the Enter? "
+            "announce no longer stamps (and the admit path no longer "
+            "adopts) the trace context the wire format documents",
+            where="async_ea._announce"))
     return findings

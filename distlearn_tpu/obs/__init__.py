@@ -3,9 +3,15 @@
 The runtime organ beside the static pair (distlint: jaxpr/protocol
 rules, distcost: compiled-HLO budgets): counters, gauges, fixed-bucket
 histograms (``obs.core``), spans with an in-memory ring + JSONL spill
-(``obs.trace``), and JSONL/Prometheus export with a ``/healthz``
-liveness endpoint (``obs.export``).  ``tools/diststat.py`` aggregates
-the JSONL trail into p50/p95/p99 tables and run diffs.
+(``obs.trace``), JSONL/Prometheus export with ``/healthz`` liveness and
+``/snapshot`` pull endpoints (``obs.export``), and the fleet half —
+cross-process trace context on the wire (``obs.trace``), snapshot
+aggregation with mergeable histograms and a declarative SLO engine
+(``obs.agg``).  ``tools/diststat.py`` aggregates one trail (or a merged
+fleet of them) into p50/p95/p99 tables and run diffs;
+``tools/tracecat.py`` stitches multi-process trails into per-trace
+waterfalls; ``tools/autoscaler.py`` closes the loop from SLO breach to
+scaling action.
 
 Instrumented layers: ``comm/transport.py`` (per-conn wire bytes, frame
 latency, timeout/drop/desync counters), ``parallel/async_ea.py``
@@ -20,13 +26,17 @@ sink; the catalog of metric and span names lives in
 docs/OBSERVABILITY.md.
 """
 
+from distlearn_tpu.obs.agg import (Collector, FleetRegistry, MergeError,
+                                   SLOEngine)
 from distlearn_tpu.obs.core import (NULL, REGISTRY, configure, counter,
                                     enabled, gauge, histogram,
                                     snapshot_record)
 from distlearn_tpu.obs.export import (set_health_source, start_http_server,
                                       write_snapshot)
-from distlearn_tpu.obs.trace import (record_span, set_spill, span, spans,
-                                     traced)
+from distlearn_tpu.obs.trace import (TRACE_KEY, new_trace, record_span,
+                                     set_process, set_propagate, set_spill,
+                                     span, spans, traced, use_context,
+                                     wire_context)
 
 __all__ = [
     "NULL",
@@ -40,9 +50,19 @@ __all__ = [
     "set_health_source",
     "start_http_server",
     "write_snapshot",
+    "Collector",
+    "FleetRegistry",
+    "MergeError",
+    "SLOEngine",
+    "TRACE_KEY",
+    "new_trace",
     "record_span",
+    "set_process",
+    "set_propagate",
     "set_spill",
     "span",
     "spans",
     "traced",
+    "use_context",
+    "wire_context",
 ]

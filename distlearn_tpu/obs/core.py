@@ -42,6 +42,7 @@ Design constraints (they shape every API here):
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 from typing import Any
@@ -270,12 +271,15 @@ class Registry:
                 for f in fams]
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format (the ``/metrics`` body)."""
+        """Prometheus text exposition format (the ``/metrics`` body).
+        Metric/label names are sanitized (stable: same input, same
+        output), label values escaped, HELP text escaped — so a scraper
+        round-trips whatever instrumentation names reach the registry."""
         out = []
         for fam in self.snapshot():
-            name = fam["name"]
+            name = _sane_name(fam["name"])
             if fam["help"]:
-                out.append(f"# HELP {name} {fam['help']}")
+                out.append(f"# HELP {name} {_escape_help(fam['help'])}")
             out.append(f"# TYPE {name} {fam['kind']}")
             for s in fam["samples"]:
                 lbl = _fmt_labels(s["labels"])
@@ -304,13 +308,35 @@ def _fmt_labels(labels: dict, **extra) -> str:
     kv = {**labels, **{k: str(v) for k, v in extra.items()}}
     if not kv:
         return ""
-    body = ",".join(f'{k}="{_escape(v)}"' for k, v in kv.items())
+    body = ",".join(f'{_sane_label(k)}="{_escape(v)}"'
+                    for k, v in kv.items())
     return "{" + body + "}"
 
 
 def _escape(v: str) -> str:
     return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
         "\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    # HELP lines escape backslash and newline only (quotes stay literal
+    # — the exposition format, not the label-value rule).
+    return str(v).replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _sane_name(name: str) -> str:
+    """Map an arbitrary metric name onto ``[a-zA-Z_:][a-zA-Z0-9_:]*``
+    deterministically (each invalid char becomes ``_``) so one registry
+    name always renders as one exposition name."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    return name if name and not name[0].isdigit() else "_" + name
+
+
+def _sane_label(name: str) -> str:
+    """Label names additionally exclude ``:`` (reserved for recording
+    rules on the Prometheus side)."""
+    name = re.sub(r"[^a-zA-Z0-9_]", "_", str(name))
+    return name if name and not name[0].isdigit() else "_" + name
 
 
 REGISTRY = Registry()

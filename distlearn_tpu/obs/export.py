@@ -8,12 +8,15 @@ Two consumers, two formats:
   with the span records ``obs.trace`` spills to the same file this is
   the trail ``tools/diststat.py`` summarizes and diffs.
 * **HTTP** — :func:`start_http_server` runs a daemon thread serving
-  Prometheus text on ``/metrics`` and a JSON liveness document on
-  ``/healthz``.  The health payload comes from a pluggable source
-  (:func:`set_health_source`) — the concurrent AsyncEA server registers
-  ``{live_clients, inflight, drained}`` on ``start()``, so an external
-  prober can distinguish "serving", "draining", and "dead" without
-  parsing logs.
+  Prometheus text on ``/metrics``, a JSON liveness document on
+  ``/healthz``, and the full registry as one JSON ``snapshot`` record
+  on ``/snapshot`` (the pull side of the fleet aggregation plane —
+  ``obs.agg.Collector`` polls it and merges every process's registry
+  into the fleet view).  The health payload comes from a pluggable
+  source (:func:`set_health_source`) — the concurrent AsyncEA server
+  registers ``{live_clients, inflight, drained}`` on ``start()``, so an
+  external prober can distinguish "serving", "draining", and "dead"
+  without parsing logs.
 
 Everything is opt-in and honors the ``DISTLEARN_OBS`` kill switch:
 disabled, :func:`write_snapshot` writes nothing and
@@ -90,6 +93,10 @@ class _Handler(BaseHTTPRequestHandler):
             doc = health()
             self._reply(200 if doc.get("ok") else 503,
                         (json.dumps(doc) + "\n").encode(),
+                        "application/json")
+        elif path == "/snapshot":
+            rec = core.snapshot_record()
+            self._reply(200, (json.dumps(rec) + "\n").encode(),
                         "application/json")
         else:
             self._reply(404, b"not found\n", "text/plain")

@@ -40,6 +40,7 @@ import numpy as np
 
 from distlearn_tpu import obs
 from distlearn_tpu.comm import Conn, ProtocolError, Server, connect, wire
+from distlearn_tpu.obs import trace as obs_trace
 from distlearn_tpu.ops import wire_kernels
 from distlearn_tpu.utils.logging import print_client, print_server, print_tester
 
@@ -448,6 +449,12 @@ class AsyncEAServer:
         # center publish (see _apply_stripe/_apply_delta overrides).
         self._sync_seq: dict[int, int | None] = {}
         self._applied_seq: dict[int, list[int]] = {}
+        # trace context claimed in the latest Enter? (None = peer not
+        # propagating) — server-side spans of that client's sync re-enter
+        # it so the whole cross-process sync shares one trace id.  Read
+        # under the same lock hold as codec/seq in the concurrent server:
+        # same-admission consistency.
+        self._trace_cid: dict[int, dict | None] = {}
         # checkpoint plumbing (enable_checkpoint); _ckpt_lock serializes
         # snapshot+save and is only ever OUTER of the concurrent server's
         # _lock (DL102: acyclic)
@@ -721,19 +728,20 @@ class AsyncEAServer:
         lo, hi = self.stripes[idx]
         b0 = conn.bytes_sent + conn.bytes_received
         center = self._stripe_center(lo, hi)
-        _expect(conn, CENTER_Q)
-        conn.send_tensors(center, codec=codec, packed=True)
-        _expect(conn, DELTA_Q)
-        conn.send_msg(DELTA)
-        dl = (None if self.handshake_timeout is None
-              else time.monotonic() + self.handshake_timeout)
-        if self._wirek and codec not in (None, "raw"):
-            # fused wire path: keep the delta in wire dtype (int8 is 4x
-            # fewer bytes to hold) and dequantize inside the apply
-            deltas = conn.recv_payload(n=hi - lo, deadline=dl)
-        else:
-            deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
-        self._check_delta(deltas, center=center)
+        with obs.span("async_ea.stripe_leg", shard=idx):
+            _expect(conn, CENTER_Q)
+            conn.send_tensors(center, codec=codec, packed=True)
+            _expect(conn, DELTA_Q)
+            conn.send_msg(DELTA)
+            dl = (None if self.handshake_timeout is None
+                  else time.monotonic() + self.handshake_timeout)
+            if self._wirek and codec not in (None, "raw"):
+                # fused wire path: keep the delta in wire dtype (int8 is
+                # 4x fewer bytes to hold) and dequantize inside the apply
+                deltas = conn.recv_payload(n=hi - lo, deadline=dl)
+            else:
+                deltas = conn.recv_tensors(n=hi - lo, deadline=dl)
+            self._check_delta(deltas, center=center)
         self._c_shard_syncs.labels(shard=idx).inc()
         self._c_shard_bytes.labels(shard=idx).inc(
             conn.bytes_sent + conn.bytes_received - b0)
@@ -787,6 +795,7 @@ class AsyncEAServer:
         applied (see ``_apply_stripe``)."""
         codec = self._wire_cid[cid]
         seq = self._sync_seq.get(cid)
+        tc = self._trace_cid.get(cid)
         ha = (cid, seq) if seq is not None else None
         w = self._delta_weight(cid)
 
@@ -798,9 +807,12 @@ class AsyncEAServer:
                 c = ep.get_conn(cid,
                                 timeout=self.handshake_timeout or 30.0)
                 c.set_timeout(self.handshake_timeout)
-            self._apply_stripe(
-                idx, self._scale_delta(self._serve_stripe_leg(c, idx, codec),
-                                       w), ha=ha)
+            # legs run on transient _fanout threads, which do not inherit
+            # the admission thread's context stack — re-enter explicitly
+            with obs_trace.use_context(tc):
+                self._apply_stripe(
+                    idx, self._scale_delta(
+                        self._serve_stripe_leg(c, idx, codec), w), ha=ha)
 
         _fanout([lambda i=i: leg(i) for i in range(len(self.stripes))])
         self._count_sync()
@@ -1116,6 +1128,10 @@ class AsyncEAServer:
         # recorded into the exactly-once ledger when the delta applies
         seq = msg.get("seq")
         self._sync_seq[cid] = seq if isinstance(seq, int) else None
+        # optional trace context: absent or malformed degrades to "no
+        # trace" — a legacy or adversarial peer must never break admission
+        tc = msg.get(obs_trace.TRACE_KEY)
+        self._trace_cid[cid] = tc if obs_trace.valid_context(tc) else None
         return cid
 
     def _reject_wire(self, cid: int, err: str):
@@ -1407,7 +1423,8 @@ class AsyncEAServer:
             codec = self._wire_cid.get(cid)
             deltas = None
             try:
-                with obs.span("async_ea.handshake", cid=cid):
+                with obs_trace.use_context(self._trace_cid.get(cid)), \
+                        obs.span("async_ea.handshake", cid=cid):
                     conn.set_timeout(self.handshake_timeout)
                     conn.send_msg(self._enter_reply(cid, ENTER))
                     print_server(f"current client is #{self.current_client}")
@@ -2428,6 +2445,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 # admission overwriting _sync_seq cannot skew this sync's
                 # ledger entry
                 seq = self._sync_seq.get(cid)
+                tc = self._trace_cid.get(cid)   # same-admission context
                 if conn is None:
                     stale = True
                 if stale:
@@ -2437,7 +2455,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
             t0 = time.perf_counter() if self._obs_on else 0.0
             try:
                 try:
-                    with obs.span("async_ea.handshake", cid=cid):
+                    with obs_trace.use_context(tc), \
+                            obs.span("async_ea.handshake", cid=cid):
                         conn.set_timeout(self.handshake_timeout)
                         conn.send_msg(self._enter_reply(cid, ENTER))
                         if sharded:
@@ -2543,6 +2562,7 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                 stale = token != self._conn_gen.get(cid, 0)
                 codec = self._wire_cid.get(cid)
                 seq = self._sync_seq.get(cid)   # same hold: same admission
+                tc = self._trace_cid.get(cid)
             try:
                 if stale:
                     continue
@@ -2570,7 +2590,8 @@ class AsyncEAServerConcurrent(AsyncEAServer):
                             time.sleep(0.05)
                         continue
                     conn.set_timeout(self.handshake_timeout)
-                    deltas = self._serve_stripe_leg(conn, idx, codec)
+                    with obs_trace.use_context(tc):
+                        deltas = self._serve_stripe_leg(conn, idx, codec)
                     conn.set_timeout(None)
                 except (TimeoutError, ConnectionError, ProtocolError,
                         OSError, ValueError) as e:
@@ -2816,6 +2837,13 @@ class AsyncEAClient:
                 # offer the pending delta's seq: the server answers with
                 # which stripes it never applied (exactly-once replay)
                 msg["replay"] = self._pending[0]
+        # optional trace context (None unless DISTLEARN_TRACE_PROP is on
+        # AND a trace is active): a key a legacy server never looks at;
+        # with propagation off the message is bitwise identical to a
+        # pre-trace client's
+        tc = obs_trace.wire_context()
+        if tc is not None:
+            msg[obs_trace.TRACE_KEY] = tc
         self.broadcast.send_msg(msg)
         reply = self.conn.recv_msg()
         if not adv:
@@ -2933,6 +2961,16 @@ class AsyncEAClient:
                 return params, False
         elif self.step % self.tau != 0:     # isSyncNeeded (lua :47-57)
             return params, False
+        if not obs_trace.propagate_enabled():
+            return self._sync_once(params)
+        # one trace per sync: the root span below is the parent every
+        # wire-context hop (center handshake, each stripe leg, the fetch
+        # and push legs here) stitches to in tools/tracecat.py
+        with obs_trace.use_context(obs_trace.new_trace()), \
+                obs.span("async_ea.sync", cid=self.node):
+            return self._sync_once(params)
+
+    def _sync_once(self, params: PyTree) -> tuple[PyTree, bool]:
         t_sync = time.perf_counter() if self.adaptive_tau else 0.0
 
         if self._sender is not None:
@@ -2960,12 +2998,16 @@ class AsyncEAClient:
         # the dedicated conn — identical to the unsharded fetch).
         if striped:
             conns = [self.conn] + self._shard_conns
+            tc0 = obs_trace.current()   # fanout threads don't inherit it
 
             def _fetch(i):
                 lo, hi = self._stripes[i]
-                conns[i].send_msg(CENTER_Q)
-                # chunk views write through into the real center leaves
-                conns[i].recv_tensors(out=vcenter[lo:hi])
+                with obs_trace.use_context(tc0), \
+                        obs.span("async_ea.fetch_center", shard=i):
+                    conns[i].send_msg(CENTER_Q)
+                    # chunk views write through into the real center
+                    # leaves
+                    conns[i].recv_tensors(out=vcenter[lo:hi])
 
             _fanout([lambda i=i: _fetch(i)
                      for i in range(len(self._stripes))])
@@ -3015,25 +3057,32 @@ class AsyncEAClient:
             self._pending = None
         # clientSendDiff (lua :122-132)
         conn = self.conn
+        # captured HERE: the push may run later on the background sender
+        # thread, which has no context stack of its own
+        tc1 = obs_trace.current()
 
         def _push_delta():
             if striped:
                 conns = [conn] + self._shard_conns
 
                 def _push(i):
-                    conns[i].send_msg(DELTA_Q)
-                    _expect(conns[i], DELTA)
-                    conns[i].send_packed(payloads[i])
+                    with obs_trace.use_context(tc1), \
+                            obs.span("async_ea.push_delta", shard=i):
+                        conns[i].send_msg(DELTA_Q)
+                        _expect(conns[i], DELTA)
+                        conns[i].send_packed(payloads[i])
 
                 _fanout([lambda i=i: _push(i) for i in range(len(payloads))])
                 return
-            conn.send_msg(DELTA_Q)
-            _expect(conn, DELTA)
-            if payloads is not None:
-                conn.send_packed(payloads[0])
-            else:
-                for d in deltas:
-                    conn.send_tensor(d)
+            with obs_trace.use_context(tc1), \
+                    obs.span("async_ea.push_delta", shard=0):
+                conn.send_msg(DELTA_Q)
+                _expect(conn, DELTA)
+                if payloads is not None:
+                    conn.send_packed(payloads[0])
+                else:
+                    for d in deltas:
+                        conn.send_tensor(d)
 
         if self._sender is not None:
             # overlap: the transmit/apply round-trip runs behind the next

@@ -53,6 +53,7 @@ import time
 from distlearn_tpu import obs
 from distlearn_tpu.comm import transport
 from distlearn_tpu.comm.errors import PeerClosed
+from distlearn_tpu.obs import trace as obs_trace
 from distlearn_tpu.serve.client import ReplicaDead, ServeError
 
 #: same decades as the server's TTFT/TPOT buckets — failover and hedge
@@ -235,6 +236,42 @@ class Router:
                 "epochs": sorted({r["epoch"] for r in live
                                   if r["epoch"] is not None})}
 
+    # -- dynamic membership (the autoscaler's actuation surface) ------------
+    def add_replica(self, host: str, port: int) -> str:
+        """Grow the fleet in place: the new member is probed on the next
+        refresh and picks up dispatch as soon as it answers live.
+        Idempotent on an address already present.  Returns the replica
+        name (``host:port``)."""
+        rep = _Replica(host, int(port))
+        with self._lock:
+            if all(r.name != rep.name for r in self._replicas):
+                # copy-on-write: generate()'s lock-free availability scan
+                # only ever sees a complete list
+                self._replicas = self._replicas + [rep]
+        return rep.name
+
+    def remove_replica(self, name: str) -> bool:
+        """Retire one member by name.  New dispatch stops immediately;
+        streams already running against it finish on their own
+        connections.  Refuses to empty the fleet (the constructor
+        invariant); returns False for an unknown name."""
+        with self._lock:
+            gone = [r for r in self._replicas if r.name == name]
+            if not gone:
+                return False
+            keep = [r for r in self._replicas if r.name != name]
+            if not keep:
+                raise ValueError("cannot remove the last replica")
+            self._replicas = keep
+        for r in gone:
+            if r.conn is not None:
+                r.conn.close()
+        return True
+
+    def replica_names(self) -> list[str]:
+        with self._lock:
+            return [r.name for r in self._replicas]
+
     # -- admission control --------------------------------------------------
     def _check_shed(self, now: float):
         if self.shed_watermark is None:
@@ -261,11 +298,29 @@ class Router:
         :class:`RouterBusy` on shed, :class:`ReplicaDead` when every
         replica was tried or attempts ran out, :class:`ServeError` on a
         non-retryable rejection, ``TimeoutError`` past ``timeout``."""
+        if not obs_trace.propagate_enabled():
+            return self._generate(prompt, max_new, rid=rid,
+                                  deadline_s=deadline_s, eos=eos,
+                                  timeout=timeout, on_chunk=on_chunk)
+        # one trace per request: this root span is the parent the
+        # replica's scheduler/engine spans stitch to (the 'G' frame
+        # carries the context) along with the failover/hedge spans here
+        with obs_trace.use_context(obs_trace.new_trace()), \
+                obs.span("router.generate", rid=rid or ""):
+            return self._generate(prompt, max_new, rid=rid,
+                                  deadline_s=deadline_s, eos=eos,
+                                  timeout=timeout, on_chunk=on_chunk)
+
+    def _generate(self, prompt, max_new: int, *, rid, deadline_s, eos,
+                  timeout, on_chunk) -> dict:
         start = self._clock()
         overall = start + float(timeout)
         self._refresh(start)
         self._check_shed(start)
         msg = {"prompt": [int(t) for t in prompt], "max_new": int(max_new)}
+        tc = obs_trace.wire_context()
+        if tc is not None:
+            msg[obs_trace.TRACE_KEY] = tc
         if rid is not None:
             msg["rid"] = rid
         if deadline_s is not None:

@@ -73,12 +73,17 @@ class Event:
     ``kind`` is ``"token"`` (one more token for ``rid``; ``first`` marks
     the prefill-produced token, i.e. the TTFT edge) or ``"finish"``
     (``reason`` in ``complete`` / ``eos`` / ``deadline`` / ``cancelled``).
+    ``waited`` rides the first-token event only: seconds the request sat
+    in the admission queue before its slot — the server turns it into
+    the ``serve.queue_wait`` span, so TTFT splits into queue wait vs
+    prefill without the scheduler touching metrics.
     """
     kind: str
     rid: str
     token: int | None = None
     first: bool = False
     reason: str | None = None
+    waited: float | None = None
 
 
 class Scheduler:
@@ -210,11 +215,13 @@ class Scheduler:
             if not self.engine.has_capacity(req.prompt.size, req.max_new):
                 break
             self._queue.popleft()
+            waited = self.clock() - req.submitted
             slot, first = self.engine.admit(req.prompt, req.max_new)
             req.slot = slot
             self._running[req.rid] = req
             self._by_slot[slot] = req
-            self._emit(req, int(first), events, first_tok=True)
+            self._emit(req, int(first), events, first_tok=True,
+                       waited=waited)
 
     def _tick(self, events: list[Event]):
         if not self._running:
@@ -225,10 +232,11 @@ class Scheduler:
                 self._emit(req, int(tok), events)
 
     def _emit(self, req: Request, tok: int, events: list[Event],
-              first_tok: bool = False):
+              first_tok: bool = False, waited: float | None = None):
         req.emitted += 1
         req.tokens.append(tok)
-        events.append(Event("token", req.rid, token=tok, first=first_tok))
+        events.append(Event("token", req.rid, token=tok, first=first_tok,
+                            waited=waited))
         done_eos = req.eos is not None and tok == req.eos
         if req.emitted >= req.max_new or done_eos:
             del self._running[req.rid]

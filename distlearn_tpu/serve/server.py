@@ -49,6 +49,7 @@ import numpy as np
 from distlearn_tpu import obs
 from distlearn_tpu.comm import transport
 from distlearn_tpu.comm.transport import ProtocolError
+from distlearn_tpu.obs import trace as obs_trace
 from distlearn_tpu.serve.engine import DecodeEngine
 from distlearn_tpu.serve.scheduler import QueueFull, Scheduler
 from distlearn_tpu.utils.checkpoint import latest_step, restore_checkpoint
@@ -130,6 +131,7 @@ class ServeServer:
         self.host, self.port = self._lst.host, self._lst.port
         self._conn_of: dict[str, transport.Conn] = {}   # rid -> client conn
         self._t_submit: dict[str, float] = {}           # rid -> perf_counter
+        self._tc_of: dict[str, dict] = {}               # rid -> trace ctx
         self._t_last: dict[str, float] = {}             # rid -> last token t
         self._rx_since: dict[transport.Conn, float] = {}  # partial-frame age
         self._failed: str | None = None                 # loop death, if any
@@ -388,6 +390,13 @@ class ServeServer:
             return
         self._conn_of[rid] = conn
         self._t_submit[rid] = time.perf_counter()
+        # optional trace context from the 'G' frame (router/client root
+        # span): TTFT/TPOT/queue-wait spans for this rid re-enter it, so
+        # the whole request stitches into one cross-process trace.
+        # Malformed or absent degrades to untraced, never rejects.
+        tc = msg.get(obs_trace.TRACE_KEY)
+        if obs_trace.valid_context(tc):
+            self._tc_of[rid] = tc
 
     def _dispatch(self, events):
         # one 'R' frame per request per round: {"rid", "tokens", "epoch",
@@ -404,16 +413,26 @@ class ServeServer:
             if ev.kind == "token":
                 chunk["tokens"].append(ev.token)
                 self._c_toks.inc()
-                if ev.first:
-                    t0 = self._t_submit.get(ev.rid)
-                    if t0 is not None:
-                        self._h_ttft.observe(now - t0)
-                        obs.record_span("serve.ttft", now - t0, rid=ev.rid)
-                else:
-                    tl = self._t_last.get(ev.rid)
-                    if tl is not None:
-                        self._h_tpot.observe(now - tl)
-                        obs.record_span("serve.tpot", now - tl, rid=ev.rid)
+                with obs_trace.use_context(self._tc_of.get(ev.rid)):
+                    if ev.first:
+                        t0 = self._t_submit.get(ev.rid)
+                        if t0 is not None:
+                            self._h_ttft.observe(now - t0)
+                            obs.record_span("serve.ttft", now - t0,
+                                            rid=ev.rid)
+                        if ev.waited is not None:
+                            # queue-wait attribution: how much of TTFT was
+                            # spent parked in the admission queue vs
+                            # decoding (the critical-path split
+                            # tools/tracecat.py reports)
+                            obs.record_span("serve.queue_wait", ev.waited,
+                                            rid=ev.rid)
+                    else:
+                        tl = self._t_last.get(ev.rid)
+                        if tl is not None:
+                            self._h_tpot.observe(now - tl)
+                            obs.record_span("serve.tpot", now - tl,
+                                            rid=ev.rid)
                 self._t_last[ev.rid] = now
             else:
                 chunk["done"] = True
@@ -444,3 +463,4 @@ class ServeServer:
         self._conn_of.pop(rid, None)
         self._t_submit.pop(rid, None)
         self._t_last.pop(rid, None)
+        self._tc_of.pop(rid, None)

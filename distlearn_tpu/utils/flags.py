@@ -106,4 +106,8 @@ OBS_FLAGS = {
                    "snapshot on exit (empty = off; see docs/OBSERVABILITY.md)"),
     "obsPort": (0, "serve /metrics + /healthz on 127.0.0.1:PORT "
                    "(0 = off)"),
+    "obsTrace": (0, "1 = stamp trace context onto outgoing wire frames "
+                    "so one sync/request is one cross-process trace "
+                    "(tools/tracecat.py); 0 = legacy bitwise-identical "
+                    "frames (same as DISTLEARN_TRACE_PROP)"),
 }

@@ -47,9 +47,13 @@ DATA_FLAGS = {
 
 
 def obs_setup(opt):
-    """Wire ``--obsLog``/``--obsPort`` (utils.flags.OBS_FLAGS): start the
-    span spill and/or the /metrics + /healthz endpoint.  Returns the HTTP
-    server handle (or None) for :func:`obs_finish`."""
+    """Wire ``--obsLog``/``--obsPort``/``--obsTrace``
+    (utils.flags.OBS_FLAGS): start the span spill, the /metrics +
+    /healthz endpoint, and/or cross-process trace propagation.  Returns
+    the HTTP server handle (or None) for :func:`obs_finish`."""
+    if getattr(opt, "obsTrace", 0):
+        from distlearn_tpu.obs import trace
+        trace.set_propagate(True)
     if not (opt.obsLog or opt.obsPort):
         return None
     from distlearn_tpu import obs

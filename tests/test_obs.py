@@ -461,3 +461,160 @@ def test_e2e_concurrent_run_jsonl_trail(clean_obs, tmp_path):
     assert checked >= 4
     # the inflight gauge settled back to zero
     assert doc["gauges"]["async_ea_inflight"] == 0
+
+
+# -- fleet aggregation satellites --------------------------------------------
+
+def _hist_sample(observations, bounds):
+    """Histogram sample dict for ``observations`` under ``bounds`` —
+    built through a real registry histogram so the test exercises the
+    same sampling path agg.py consumes."""
+    from distlearn_tpu.obs import agg  # noqa: F401  (import guard)
+    reg = core.Registry()
+    h = reg.histogram("t_merge_seconds", buckets=bounds)
+    for v in observations:
+        h.observe(v)
+    return reg._families["t_merge_seconds"].sample()[0]
+
+
+def test_histogram_merge_identical_bounds_is_exact(clean_obs):
+    """Property (ISSUE satellite): for identical bucket bounds,
+    merge(sample(A), sample(B)) == sample(A + B) — bucket counts, count,
+    inf and sum all add exactly, over randomized observation sets."""
+    from distlearn_tpu.obs import agg
+
+    bounds = (0.001, 0.01, 0.1, 1.0)
+    rng = np.random.default_rng(20260806)
+    for _trial in range(20):
+        na, nb = int(rng.integers(0, 40)), int(rng.integers(0, 40))
+        a = [float(x) for x in rng.lognormal(-3, 2, size=na)]
+        b = [float(x) for x in rng.lognormal(-3, 2, size=nb)]
+        merged = agg.merge_histograms(_hist_sample(a, bounds),
+                                      _hist_sample(b, bounds))
+        whole = _hist_sample(a + b, bounds)
+        assert merged["count"] == whole["count"] == na + nb
+        assert merged["inf"] == whole["inf"]
+        assert merged["buckets"] == whole["buckets"]
+        assert abs(merged["sum"] - whole["sum"]) < 1e-9 * max(
+            1.0, abs(whole["sum"]))
+
+
+def test_histogram_merge_mismatched_bounds_raise(clean_obs):
+    """Mismatched bucket bounds refuse to merge (MergeError), both via
+    the free function and through FleetRegistry.merged()."""
+    from distlearn_tpu.obs import agg
+
+    a = _hist_sample([0.05], (0.01, 0.1))
+    b = _hist_sample([0.05], (0.01, 1.0))
+    with pytest.raises(agg.MergeError):
+        agg.merge_histograms(a, b)
+
+    fleet = agg.FleetRegistry()
+    for src, bounds in (("p0", (0.01, 0.1)), ("p1", (0.01, 1.0))):
+        reg = core.Registry()
+        reg.histogram("t_skew_seconds", buckets=bounds).observe(0.05)
+        fleet.ingest({"type": "snapshot", "ts": 1.0,
+                      "metrics": reg.snapshot()}, source=src)
+    with pytest.raises(agg.MergeError):
+        fleet.merged()
+    # kind skew between sources is the same class of config error
+    fleet2 = agg.FleetRegistry()
+    reg_c = core.Registry()
+    reg_c.counter("t_kind_skew").inc()
+    reg_g = core.Registry()
+    reg_g.gauge("t_kind_skew").set(1)
+    fleet2.ingest({"type": "snapshot", "ts": 1.0,
+                   "metrics": reg_c.snapshot()}, source="p0")
+    fleet2.ingest({"type": "snapshot", "ts": 1.0,
+                   "metrics": reg_g.snapshot()}, source="p1")
+    with pytest.raises(agg.MergeError):
+        fleet2.merged()
+
+
+def test_estimate_quantile_interpolation(clean_obs):
+    from distlearn_tpu.obs import agg
+
+    # 100 observations uniform in (0, 1) binned at 0.25/0.5/0.75/1.0:
+    # the p50 sits at the 0.5 bound, p95 interpolates inside (0.75, 1].
+    s = _hist_sample([(i + 0.5) / 100 for i in range(100)],
+                     (0.25, 0.5, 0.75, 1.0))
+    assert abs(agg.estimate_quantile(s, 0.50) - 0.50) < 0.02
+    assert abs(agg.estimate_quantile(s, 0.95) - 0.95) < 0.02
+    assert agg.estimate_quantile({"count": 0, "buckets": {}}, 0.5) != \
+        agg.estimate_quantile({"count": 0, "buckets": {}}, 0.5)  # NaN
+    # everything past the last bound clamps to the highest finite bound
+    hot = _hist_sample([5.0, 6.0, 7.0], (0.25, 0.5, 0.75, 1.0))
+    assert agg.estimate_quantile(hot, 0.99) == 1.0
+
+
+def _parse_prometheus(text: str) -> dict:
+    """Minimal Prometheus text-format parser: name{labels} -> float,
+    plus the # TYPE lines.  Understands escaped label values."""
+    types, values = {}, {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+            continue
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        values[key] = float(val)
+    return {"types": types, "values": values}
+
+
+def test_prometheus_scrape_and_parse_roundtrip(clean_obs):
+    """Exposition audit (ISSUE satellite): scrape /metrics over HTTP and
+    parse it back — names sanitized, label values with quotes/newlines
+    escaped so the line still parses, histograms typed and cumulative."""
+    obs.counter("t_rt_total", "round trip").inc(3)
+    fam = obs.counter("t-rt.bad name_total", labels=("q",))
+    fam.labels(q='he said "hi"\nand \\ left').inc(5)
+    h = obs.histogram("t_rt_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    srv = obs.start_http_server(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+    finally:
+        srv.close()
+
+    doc = _parse_prometheus(text)
+    assert doc["types"]["t_rt_total"] == "counter"
+    assert doc["types"]["t_rt_seconds"] == "histogram"
+    assert doc["values"]["t_rt_total"] == 3
+    # the dotted/hyphenated name was sanitized into one valid metric name
+    assert doc["values"][
+        't_rt_bad_name_total{q="he said \\"hi\\"\\nand \\\\ left"}'] == 5
+    # histogram buckets render cumulative with a closing +Inf == count
+    assert doc["values"]['t_rt_seconds_bucket{le="0.1"}'] == 1
+    assert doc["values"]['t_rt_seconds_bucket{le="1.0"}'] == 2
+    assert doc["values"]['t_rt_seconds_bucket{le="+Inf"}'] == 3
+    assert doc["values"]["t_rt_seconds_count"] == 3
+    assert abs(doc["values"]["t_rt_seconds_sum"] - 5.55) < 1e-9
+    # every sample line's metric name is a valid Prometheus identifier
+    import re
+    for key in doc["values"]:
+        name = key.split("{", 1)[0]
+        assert re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name), key
+
+
+def test_spans_dropped_surfaced_in_diststat(clean_obs, tmp_path, capsys):
+    """Ring overflow increments obs_spans_dropped_total, which survives
+    into the snapshot and makes ``diststat`` lead with a WARNING."""
+    trace.set_ring_size(4)
+    try:
+        for i in range(10):
+            trace.record_span("t.noise", 0.001, i=i)
+    finally:
+        trace.set_ring_size(4096)
+    log = str(tmp_path / "trail.jsonl")
+    obs.write_snapshot(log)
+    doc = diststat.summarize_run([log])
+    assert doc["counter_totals"]["obs_spans_dropped_total"] == 6
+    diststat._print_summary(doc)
+    out = capsys.readouterr().out
+    assert "WARNING" in out and "dropped 6" in out

@@ -1,0 +1,635 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md): cross-process
+trace propagation — one trace per AsyncEA sync and per serve request,
+stitched into a waterfall by tools/tracecat.py — the legacy wire parity
+when propagation is off, the fleet aggregation + SLO engine
+(obs/agg.py), the obs-driven autoscaler policy (tools/autoscaler.py),
+and the traffic-shape chaos scenarios that soak the whole loop."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distlearn_tpu import obs
+from distlearn_tpu.obs import agg, core, trace
+from distlearn_tpu.utils.logging import set_verbose
+
+set_verbose(False)
+
+from tests.net_util import reserve_port_window
+
+_TOOLS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+sys.path.insert(0, _TOOLS)
+
+import autoscaler as autoscaler_mod  # noqa: E402
+import tracecat  # noqa: E402
+
+pytestmark = pytest.mark.obsplane
+
+VOCAB, DIM, DEPTH, HEADS, MAX_LEN = 61, 32, 2, 4, 64
+
+
+@pytest.fixture()
+def traced_obs():
+    """Obs force-enabled with trace PROPAGATION on (the non-default the
+    plane tests need), fresh registry/ring, everything restored after."""
+    core.configure(True)
+    core.REGISTRY.reset()
+    trace.clear()
+    trace.set_spill(None)
+    trace.set_propagate(True)
+    yield
+    trace.set_propagate(None)
+    trace.set_spill(None)
+    trace.clear()
+    core.REGISTRY.reset()
+    core.configure(None)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    import jax
+    from distlearn_tpu.models.transformer import transformer_lm
+    model = transformer_lm(vocab=VOCAB, dim=DIM, depth=DEPTH, heads=HEADS,
+                           max_len=MAX_LEN)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    return params
+
+
+def _ea_params():
+    # same shape set as the shard tests: S=4 stripes AND splits the
+    # dominant leaf, so every fanned-out leg appears in the trace
+    return {"a": np.zeros((64, 3), np.float32),
+            "b": np.zeros((7,), np.float32),
+            "c": np.zeros((32, 32), np.float32),
+            "d": np.zeros((5,), np.float32),
+            "e": np.zeros((128,), np.float32)}
+
+
+def _one_striped_sync(shards=4):
+    """One serial S-striped AsyncEA sync (init + a single tau=1 round);
+    returns the client's stripe plan."""
+    from distlearn_tpu.parallel.async_ea import AsyncEAClient, AsyncEAServer
+    port = reserve_port_window(12)
+    out = {}
+
+    def client_fn():
+        c = AsyncEAClient("127.0.0.1", port, node=1, tau=1, alpha=0.5,
+                          sharded=True)
+        p = c.init_client(_ea_params())
+        p = {k: v + 1.0 for k, v in p.items()}
+        _, out["synced"] = c.sync_client(p)
+        out["stripes"] = c._stripes
+        c.close()
+
+    th = threading.Thread(target=client_fn, daemon=True)
+    th.start()
+    srv = AsyncEAServer("127.0.0.1", port, num_nodes=1, shards=shards)
+    srv.init_server(_ea_params())
+    srv.sync_server(_ea_params())
+    th.join(timeout=60)
+    assert not th.is_alive() and out["synced"]
+    srv.close()
+    return out["stripes"]
+
+
+# -- e2e: one trace per logical operation -------------------------------------
+
+def test_async_ea_striped_sync_is_one_trace(traced_obs, tmp_path):
+    """ISSUE acceptance: an S=4 striped sync emits exactly ONE trace —
+    the client's ``async_ea.sync`` root — and tracecat stitches the
+    spilled trail into a waterfall whose parentage matches ground truth:
+    the server handshake, all four server stripe legs, and the client's
+    four fetch + four push legs all hang directly off the root (the
+    wire context every hop carried)."""
+    log = str(tmp_path / "fleet.jsonl")
+    trace.set_spill(log)
+    try:
+        stripes = _one_striped_sync(shards=4)
+    finally:
+        trace.set_spill(None)
+    S = len(stripes)
+    assert S == 4
+
+    spans = tracecat.load_spans([log])
+    traces = tracecat.group_traces(spans)
+    assert len(traces) == 1, sorted(traces)
+    (tid, recs), = traces.items()
+    assert len(tid) == 16 and int(tid, 16) >= 0
+
+    roots, children = tracecat.build_tree(recs)
+    assert [r["name"] for r in roots] == ["async_ea.sync"]
+    root_id = roots[0]["span"]
+    by_name: dict[str, list] = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    # ground truth for one S=4 sync
+    assert len(by_name["async_ea.handshake"]) == 1
+    assert len(by_name["async_ea.stripe_leg"]) == S
+    assert len(by_name["async_ea.fetch_center"]) == S
+    assert len(by_name["async_ea.push_delta"]) == S
+    for name, want_parent in (("async_ea.handshake", root_id),
+                              ("async_ea.stripe_leg", root_id),
+                              ("async_ea.fetch_center", root_id),
+                              ("async_ea.push_delta", root_id)):
+        for r in by_name[name]:
+            assert r["trace"] == tid
+            assert r.get("parent") == want_parent, (name, r)
+    # shard labels cover every stripe on each fanned-out leg
+    for name in ("async_ea.stripe_leg", "async_ea.fetch_center",
+                 "async_ea.push_delta"):
+        assert {r["labels"]["shard"] for r in by_name[name]} \
+            == set(range(S))
+    # the waterfall renders and the critical path starts at the root
+    cp = tracecat.critical_path(recs)
+    assert cp and cp[0]["name"] == "async_ea.sync"
+    text = tracecat.render_trace(tid, recs)
+    assert "async_ea.sync" in text and "critical path" in text
+
+
+def test_serve_request_is_one_trace(traced_obs, lm_params, tmp_path):
+    """One routed serve request = one trace: ``router.generate`` is the
+    root; the replica's queue-wait, TTFT and every TPOT span stitch to
+    it through the trace context on the 'G' frame."""
+    from distlearn_tpu.serve import DecodeEngine, Router, ServeServer
+    log = str(tmp_path / "serve.jsonl")
+    eng = DecodeEngine(lm_params, num_slots=2, max_len=MAX_LEN, page=8)
+    srv = ServeServer(eng, idle_wait=0.01).start()
+    max_new = 5
+    try:
+        trace.set_spill(log)
+        with Router([(srv.host, srv.port)], health_ttl=0.02,
+                    retry_interval=0.01, dial_deadline=1.0) as router:
+            r = router.generate([1, 2, 3], max_new, rid="q0")
+        assert r["reason"] == "complete" and len(r["tokens"]) == max_new
+    finally:
+        trace.set_spill(None)
+        srv.stop()
+
+    traces = tracecat.group_traces(tracecat.load_spans([log]))
+    assert len(traces) == 1, sorted(traces)
+    (tid, recs), = traces.items()
+    roots, _children = tracecat.build_tree(recs)
+    assert [r["name"] for r in roots] == ["router.generate"]
+    root_id = roots[0]["span"]
+    by_name: dict[str, list] = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    assert len(by_name["serve.queue_wait"]) == 1
+    assert len(by_name["serve.ttft"]) == 1
+    assert len(by_name["serve.tpot"]) == max_new - 1
+    for name in ("serve.queue_wait", "serve.ttft", "serve.tpot"):
+        for r in by_name[name]:
+            assert r["trace"] == tid and r.get("parent") == root_id
+    # attribution accounts the decode legs against the request window
+    shares = {a["name"] for a in tracecat.attribution(recs)}
+    assert {"router.generate", "serve.ttft"} <= shares
+
+
+def test_tracecat_cli_stitches_multiple_trails(traced_obs, tmp_path):
+    """list/show over two trails (two "processes") joins spans by trace
+    id — the multi-process stitch, exercised at the CLI boundary."""
+    t0 = time.time()
+    a, b = str(tmp_path / "router.jsonl"), str(tmp_path / "replica.jsonl")
+    with open(a, "w") as fh:
+        fh.write(json.dumps({
+            "type": "span", "name": "router.generate", "ts": t0 + 0.1,
+            "dur": 0.1, "trace": "ab" * 8, "span": "11111111",
+            "proc": "router"}) + "\n")
+    with open(b, "w") as fh:
+        fh.write(json.dumps({
+            "type": "span", "name": "serve.ttft", "ts": t0 + 0.08,
+            "dur": 0.06, "trace": "ab" * 8, "span": "22222222",
+            "parent": "11111111", "proc": "replica"}) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "tracecat.py"),
+         "list", a, b], capture_output=True, text=True, check=True)
+    assert "ab" * 8 in out.stdout and "2" in out.stdout
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "tracecat.py"),
+         "show", a, b, "--format", "json"],
+        capture_output=True, text=True, check=True)
+    doc = json.loads(out.stdout)
+    assert doc["summary"]["trace"] == "ab" * 8
+    assert doc["summary"]["spans"] == 2
+    assert doc["critical_path"] == ["11111111", "22222222"]
+    assert sorted(doc["summary"]["procs"]) == ["replica", "router"]
+
+
+# -- legacy parity: propagation off => bitwise-identical frames ---------------
+
+def test_trace_absent_is_bitwise_legacy(traced_obs, monkeypatch):
+    """With propagation OFF (the default), no control frame carries the
+    ``tc`` field and the message stream is exactly the pre-plane one:
+    the ON-run stream minus that one optional key.  This is the
+    mixed-fleet interop guarantee — an untraced peer sees frames
+    indistinguishable from a fleet that predates the plane."""
+    from distlearn_tpu.comm import transport
+
+    sent: list = []
+    orig = transport.Conn.send_msg
+
+    def spy(self, msg):
+        sent.append(msg)
+        return orig(self, msg)
+
+    monkeypatch.setattr(transport.Conn, "send_msg", spy)
+
+    trace.set_propagate(False)
+    with trace.use_context(trace.new_trace()):
+        assert trace.wire_context() is None     # nothing to stamp
+    _one_striped_sync(shards=1)
+    off_run = list(sent)
+    assert all(trace.TRACE_KEY not in m
+               for m in off_run if isinstance(m, dict))
+    # propagation off: spans still record, but carry no trace ids
+    assert all("trace" not in r for r in trace.spans())
+
+    sent.clear()
+    trace.set_propagate(True)
+    _one_striped_sync(shards=1)
+    on_run = list(sent)
+    stamped = [m for m in on_run
+               if isinstance(m, dict) and trace.TRACE_KEY in m]
+    assert stamped, "propagation on stamped no frame"
+    for m in stamped:
+        assert trace.valid_context(m[trace.TRACE_KEY])
+    stripped = [({k: v for k, v in m.items() if k != trace.TRACE_KEY}
+                 if isinstance(m, dict) else m) for m in on_run]
+    assert stripped == off_run
+
+
+# -- fixed fleet: the plane observes, a disabled autoscaler never acts --------
+
+def test_fixed_fleet_unaffected_when_autoscaler_disabled(traced_obs,
+                                                         lm_params):
+    """ISSUE acceptance: a fixed fleet with ``enabled=False`` decodes
+    token-identically to a plain fleet — the disabled loop never polls,
+    never evaluates, never touches the router."""
+    from distlearn_tpu.models.transformer import greedy_generate
+    from distlearn_tpu.serve import DecodeEngine, Router, ServeServer
+
+    class _Untouchable:
+        def __getattr__(self, name):
+            raise AssertionError(f"disabled autoscaler used .{name}")
+
+    act = autoscaler_mod.Actuator(
+        spawn=lambda: (_ for _ in ()).throw(AssertionError("spawned")),
+        retire=lambda h: (_ for _ in ()).throw(AssertionError("retired")),
+        min_size=1, max_size=4, initial=1)
+    scaler = autoscaler_mod.Autoscaler(
+        _Untouchable(), _Untouchable(), act, enabled=False)
+
+    prompts = [np.array([3, 1, 4], np.int32), np.array([2, 7], np.int32)]
+    refs = [np.asarray(greedy_generate(
+        lm_params, p[None], 4))[0].tolist() for p in prompts]
+    eng = DecodeEngine(lm_params, num_slots=2, max_len=MAX_LEN, page=8)
+    srv = ServeServer(eng, idle_wait=0.01).start()
+    try:
+        with Router([(srv.host, srv.port)], health_ttl=0.02,
+                    retry_interval=0.01, dial_deadline=1.0) as router:
+            for i, p in enumerate(prompts):
+                report = scaler.step()
+                assert report == {"action": "disabled", "size": 1,
+                                  "breached": [], "events": []}
+                r = router.generate(p, 4, rid=f"q{i}")
+                assert r["tokens"] == refs[i]
+            assert len(router.replica_names()) == 1
+    finally:
+        srv.stop()
+    # the obs kill switch disables the loop the same way
+    core.configure(False)
+    try:
+        s2 = autoscaler_mod.Autoscaler(
+            _Untouchable(), _Untouchable(), act, enabled=True)
+        assert s2.step()["action"] == "disabled"
+    finally:
+        core.configure(True)
+
+
+# -- fleet registry / collector -----------------------------------------------
+
+def _snap(reg):
+    return {"type": "snapshot", "ts": time.time(),
+            "metrics": reg.snapshot()}
+
+
+def test_fleet_registry_replace_not_add(traced_obs):
+    """Per-source replace semantics: re-ingesting a later cumulative
+    snapshot from the same process must not double its contribution."""
+    fleet = agg.FleetRegistry()
+    reg = core.Registry()
+    c = reg.counter("t_fleet_total")
+    c.inc(3)
+    fleet.ingest(_snap(reg), source="p0")
+    c.inc(4)
+    fleet.ingest(_snap(reg), source="p0")
+    assert fleet.total("t_fleet_total") == 7
+    reg2 = core.Registry()
+    reg2.counter("t_fleet_total").inc(10)
+    fleet.ingest(_snap(reg2), source="p1")
+    assert fleet.total("t_fleet_total") == 17
+    assert fleet.breakdown("t_fleet_total") == {"p0": 7.0, "p1": 10.0}
+    fleet.forget("p1")
+    assert fleet.total("t_fleet_total") == 7
+    with pytest.raises(ValueError):
+        fleet.ingest({"type": "span"}, source="p0")
+
+
+def test_fleet_registry_merges_histograms_and_matches(traced_obs):
+    fleet = agg.FleetRegistry()
+    for src, vals in (("p0", (0.05, 0.2)), ("p1", (0.05, 5.0))):
+        reg = core.Registry()
+        h = reg.histogram("t_fl_seconds", buckets=(0.1, 1.0))
+        for v in vals:
+            h.observe(v)
+        reg.counter("t_out_total", labels=("outcome",)).labels(
+            outcome="ok" if src == "p0" else "shed").inc(2)
+        fleet.ingest(_snap(reg), source=src)
+    merged = fleet.histogram("t_fl_seconds")
+    assert merged["count"] == 4 and merged["inf"] == 1
+    assert merged["buckets"] == {"0.1": 2, "1.0": 1}
+    assert fleet.total("t_out_total", {"outcome": "ok"}) == 2
+    assert fleet.total("t_out_total") == 4
+
+
+def test_collector_polls_http_and_trail(traced_obs, tmp_path):
+    """One poll round ingests a live /snapshot endpoint AND a JSONL
+    trail; a dead endpoint counts a failure but leaves the rest of the
+    fleet view intact."""
+    obs.counter("t_live_total").inc(5)
+    srv = obs.start_http_server(0)
+    trail = str(tmp_path / "replica.jsonl")
+    reg = core.Registry()
+    reg.counter("t_live_total").inc(7)
+    with open(trail, "w") as fh:
+        fh.write(json.dumps({"type": "span", "name": "x", "ts": 0,
+                             "dur": 0}) + "\n")
+        fh.write(json.dumps(_snap(reg)) + "\n")
+    dead = reserve_port_window(1)
+    try:
+        coll = agg.Collector(endpoints=[("127.0.0.1", srv.port),
+                                        ("127.0.0.1", dead)],
+                             trails=[trail], timeout=0.5)
+        fleet = coll.poll()
+    finally:
+        srv.close()
+    assert fleet.total("t_live_total") == 12
+    assert set(fleet.sources()) == {f"http://127.0.0.1:{srv.port}",
+                                    os.path.basename(trail)}
+    assert core.REGISTRY._families["obs_agg_polls_total"].value == 1
+    fails = {s["labels"]["source"]: s["value"]
+             for s in core.REGISTRY._families[
+                 "obs_agg_poll_failures_total"].sample()}
+    assert fails == {f"http://127.0.0.1:{dead}": 1}
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+def _fleet_with_hist(observations, *, name="t_slo_seconds",
+                     buckets=(0.1, 1.0), source="p0", fleet=None):
+    fleet = fleet if fleet is not None else agg.FleetRegistry()
+    reg = core.Registry()
+    h = reg.histogram(name, buckets=buckets)
+    for v in observations:
+        h.observe(v)
+    fleet.ingest(_snap(reg), source=source)
+    return fleet
+
+
+def test_slo_windowed_quantile_breaches_then_recovers(traced_obs):
+    """A burst breaches the windowed p50; once the burst leaves the
+    trailing window (no new samples), the rule recovers — the property
+    a cumulative histogram alone can never give."""
+    slo = agg.SLOEngine([{"name": "lat", "kind": "quantile",
+                          "metric": "t_slo_seconds", "q": 0.5,
+                          "target": 0.1, "window_s": 5.0}])
+    reg = core.Registry()
+    h = reg.histogram("t_slo_seconds", buckets=(0.1, 1.0))
+    fleet = agg.FleetRegistry()
+
+    fleet.ingest(_snap(reg), source="p0")
+    (e,) = slo.evaluate(fleet, now=0.0)
+    assert e["ok"] and not e["changed"]         # no data: never pages
+
+    for _ in range(10):
+        h.observe(0.9)                          # the burst
+    fleet.ingest(_snap(reg), source="p0")
+    (e,) = slo.evaluate(fleet, now=2.0)
+    assert not e["ok"] and e["changed"] and e["value"] > 0.1
+    assert slo.breached() == ["lat"]
+    (e,) = slo.evaluate(fleet, now=4.0)         # burst still in window
+    assert not e["ok"] and not e["changed"]
+    (e,) = slo.evaluate(fleet, now=8.0)         # burst aged out
+    assert e["ok"] and e["changed"] and slo.breached() == []
+    assert core.REGISTRY._families[
+        "slo_breaches_total"].labels(slo="lat").value == 1
+    assert core.REGISTRY._families[
+        "slo_recoveries_total"].labels(slo="lat").value == 1
+    names = [r["name"] for r in trace.spans()]
+    assert names.count("slo.breach") == 1
+    assert names.count("slo.recover") == 1
+
+
+def test_slo_windowed_quantile_counter_reset(traced_obs):
+    """A source restart (count shrinks) clears the window history and
+    falls back to the fresh cumulative view instead of going negative."""
+    slo = agg.SLOEngine([{"name": "lat", "kind": "quantile",
+                          "metric": "t_slo_seconds", "q": 0.5,
+                          "target": 0.1, "window_s": 5.0}])
+    fleet = _fleet_with_hist([0.9] * 8)
+    slo.evaluate(fleet, now=0.0)
+    assert slo.breached() == ["lat"]
+    fleet = _fleet_with_hist([0.05, 0.05, 0.05])    # restarted source
+    (e,) = slo.evaluate(fleet, now=1.0)
+    assert e["ok"] and 0 < e["value"] <= 0.1
+
+
+def test_slo_cumulative_quantile_and_burn_rate(traced_obs):
+    """Without window_s the quantile is over everything ever observed;
+    the burn-rate rule pages on the windowed bad/total ratio."""
+    slo = agg.SLOEngine([
+        {"name": "lat", "kind": "quantile", "metric": "t_slo_seconds",
+         "q": 0.95, "target": 1.0},
+        {"name": "errs", "kind": "burn_rate", "total": "req_total",
+         "bad": "bad_total", "budget": 0.1, "window_s": 10.0,
+         "max_burn": 1.0},
+    ])
+    fleet = _fleet_with_hist([0.05] * 20)
+    reg = core.Registry()
+    t, b = reg.counter("req_total"), reg.counter("bad_total")
+    t.inc(100)
+    b.inc(1)
+    fleet.ingest(_snap(reg), source="p1")
+    events = {e["slo"]: e for e in slo.evaluate(fleet, now=0.0)}
+    assert events["lat"]["ok"] and events["errs"]["ok"]
+    t.inc(100)
+    b.inc(49)                                   # 49% of the new traffic
+    fleet.ingest(_snap(reg), source="p1")
+    events = {e["slo"]: e for e in slo.evaluate(fleet, now=5.0)}
+    assert not events["errs"]["ok"]
+    assert abs(events["errs"]["value"] - 4.9) < 1e-9
+    assert events["lat"]["ok"]                  # cumulative p95 unmoved
+
+
+def test_slo_rule_validation():
+    with pytest.raises(ValueError):
+        agg.SLOEngine([{"kind": "quantile"}])               # no name
+    with pytest.raises(ValueError):
+        agg.SLOEngine([{"name": "x", "kind": "nope"}])      # bad kind
+    with pytest.raises(ValueError):
+        agg.SLOEngine([{"name": "x", "kind": "quantile"}])  # missing keys
+    with pytest.raises(ValueError):
+        agg.SLOEngine([{"name": "x", "kind": "burn_rate",
+                        "total": "a", "bad": "b"}])
+
+
+# -- autoscaler policy --------------------------------------------------------
+
+class _ScriptedPlane:
+    """A collector+SLO pair scripted per round: poll() returns an empty
+    fleet, evaluate() replays the scripted ok/breach pattern."""
+
+    def __init__(self, script):
+        self.script = list(script)      # each round: list of breached rules
+        self.fleet = agg.FleetRegistry()
+        self._last: list = []
+
+    def poll(self):
+        return self.fleet
+
+    def evaluate(self, fleet):
+        bad = self.script.pop(0) if self.script else []
+        self._last = bad
+        return [{"slo": n, "kind": "quantile", "ok": n not in bad,
+                 "value": 1.0, "target": 0.1, "changed": False}
+                for n in ("ttft", "ignored")]
+
+
+def test_autoscaler_scales_up_on_breach_down_after_cooldown(traced_obs):
+    clk = {"t": 0.0}
+    spawned, retired = [], []
+    act = autoscaler_mod.Actuator(
+        spawn=lambda: spawned.append(len(spawned)) or len(spawned),
+        retire=retired.append, min_size=1, max_size=3, initial=1)
+    plane = _ScriptedPlane([["ttft"], ["ttft"], ["ttft"], [], [], []])
+    scaler = autoscaler_mod.Autoscaler(
+        plane, plane, act, scale_on={"ttft"}, cooldown_s=10.0,
+        clock=lambda: clk["t"])
+
+    assert scaler.step()["action"] == "up" and act.size == 2
+    clk["t"] = 1.0
+    assert scaler.step()["action"] == "up" and act.size == 3
+    clk["t"] = 2.0
+    r = scaler.step()                           # max bound holds
+    assert r["action"] == "hold" and act.size == 3 and r["breached"]
+    clk["t"] = 5.0
+    assert scaler.step()["action"] == "hold"    # clean but not cooled
+    clk["t"] = 13.0                             # 11s after last breach
+    assert scaler.step()["action"] == "down" and act.size == 2
+    clk["t"] = 14.0
+    assert scaler.step()["action"] == "hold"    # cooldown re-armed by act
+    clk["t"] = 24.0
+    assert scaler.step()["action"] == "down" and act.size == 1
+    clk["t"] = 40.0
+    assert scaler.step()["action"] == "hold"    # min bound holds
+    assert retired == [2, 1]                    # LIFO: newest first
+    ups = core.REGISTRY._families[
+        "autoscaler_scale_events_total"].labels(direction="up").value
+    downs = core.REGISTRY._families[
+        "autoscaler_scale_events_total"].labels(direction="down").value
+    assert (ups, downs) == (2, 2)
+    assert core.REGISTRY._families["autoscaler_target_size"].value == 1
+    names = [r["name"] for r in trace.spans()]
+    assert names.count("autoscaler.scale_up") == 2
+    assert names.count("autoscaler.scale_down") == 2
+
+
+def test_autoscaler_ignores_unwatched_rules_and_steady_state(traced_obs):
+    """Breaches outside scale_on never scale; a fleet that never
+    breached never shrinks below what the operator started."""
+    act = autoscaler_mod.Actuator(spawn=lambda: 1, retire=lambda h: None,
+                                  min_size=1, max_size=3, initial=2)
+    plane = _ScriptedPlane([["ignored"], [], []])
+    clk = {"t": 0.0}
+    scaler = autoscaler_mod.Autoscaler(
+        plane, plane, act, scale_on={"ttft"}, cooldown_s=0.1,
+        clock=lambda: clk["t"])
+    assert scaler.step()["action"] == "hold"
+    clk["t"] = 100.0
+    assert scaler.step()["action"] == "hold" and act.size == 2
+    with pytest.raises(ValueError):
+        autoscaler_mod.Actuator(spawn=lambda: 1, retire=lambda h: None,
+                                min_size=3, max_size=2)
+
+
+def test_autoscaler_cli_dry_run(traced_obs, tmp_path):
+    """The CLI monitor: rules from JSON, a trail as the fleet source,
+    one JSON report per round, no spawn authority."""
+    trail = str(tmp_path / "p0.jsonl")
+    reg = core.Registry()
+    h = reg.histogram("serve_ttft_seconds", buckets=(0.025, 0.1, 1.0))
+    for _ in range(10):
+        h.observe(0.9)
+    with open(trail, "w") as fh:
+        fh.write(json.dumps(_snap(reg)) + "\n")
+    rules = str(tmp_path / "slo.json")
+    with open(rules, "w") as fh:
+        json.dump([{"name": "ttft-p95", "kind": "quantile",
+                    "metric": "serve_ttft_seconds", "q": 0.95,
+                    "target": 0.05}], fh)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_TOOLS, "autoscaler.py"),
+         "--trail", trail, "--rules", rules, "--interval", "0",
+         "--rounds", "2"],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "DISTLEARN_OBS": "1"})
+    reports = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+    assert len(reports) == 2
+    assert reports[0]["action"] == "up"         # dry-run handle only
+    assert reports[0]["breached"] == ["ttft-p95"]
+
+
+# -- traffic-shape scenarios (tools/chaos.py) ---------------------------------
+
+def _chaos():
+    import chaos
+    return chaos
+
+
+@pytest.mark.chaos
+def test_scenario_zipf_mix():
+    report = _chaos().run_scenario("zipf_mix", rounds=8)
+    assert report["failures"] == []
+    assert report["head_share"] >= 0.25
+    assert report["completed"] == report["requests"]
+
+
+@pytest.mark.chaos
+def test_scenario_diurnal():
+    report = _chaos().run_scenario("diurnal", rounds=8)
+    assert report["failures"] == []
+    assert report["breaches"] >= 1 and report["recoveries"] >= 1
+    assert report["phases_breached"] >= 1
+
+
+@pytest.mark.chaos
+def test_scenario_flash_crowd():
+    """ISSUE acceptance: the obs-driven autoscaler rides a 10x flash
+    crowd — scale up under breach, hold, retire after cooldown — and
+    the SLO engine logs the breach AND the recovery."""
+    report = _chaos().run_scenario("flash_crowd", rounds=8)
+    assert report["failures"] == []
+    assert report["burst"] == 10 * report["baseline"]
+    assert report["peak_size"] >= 2 and report["scale_ups"] >= 1
+    assert report["scale_downs"] >= 1
+    assert report["breaches"] >= 1 and report["recoveries"] >= 1

@@ -66,6 +66,24 @@ Four more scenarios drive the SERVING fleet (docs/SERVING.md): a
   zero failed streams, zero fence violations, no stream observes two
   epochs.
 
+Three TRAFFIC scenarios exercise the observability plane end-to-end
+(docs/OBSERVABILITY.md) — realistic request mixes instead of injected
+faults —
+
+* ``zipf_mix``     — Zipf-popularity request catalog over a 2-replica
+  fleet; every repeat of a prompt must decode to the identical token
+  stream on whichever replica served it (greedy decode is a fleet-wide
+  contract), and the obs counters must account for every request;
+* ``diurnal``      — a one-day sine of wave sizes against one replica;
+  the windowed TTFT-p95 SLO must breach at the peak and recover once
+  the trough traffic leaves the window (``slo_breaches_total`` /
+  ``slo_recoveries_total`` both fire);
+* ``flash_crowd``  — a 10x request burst against a one-replica fleet;
+  the obs-driven autoscaler (tools/autoscaler.py) must scale up on the
+  TTFT breach, the burst must complete with the spawned replica taking
+  real dispatches, and after the crowd passes the fleet must cool down
+  and retire back to baseline.
+
 Settle/recovery budgets honor ``DISTLEARN_CHAOS_SETTLE_S`` and
 ``DISTLEARN_CHAOS_RECOVER_S`` (seconds) for slow CI machines.
 
@@ -77,6 +95,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import shutil
 import socket
@@ -1230,6 +1249,311 @@ def _scenario_swap_during_traffic(rounds, seed, host):
             "fence_violations": fences}, failures
 
 
+# ---------------------------------------------------------------------------
+# Traffic scenarios (docs/OBSERVABILITY.md): realistic request mixes
+# driving the observability plane — windowed SLOs and the obs-driven
+# autoscaler — instead of injected faults.
+
+def _throttle_ticks(srv, delay: float):
+    """Make one replica's decode step cost ``delay`` seconds: queueing
+    (and therefore TTFT under load) becomes a deterministic function of
+    backlog instead of machine speed."""
+    orig_tick = srv.engine.tick
+
+    def _slow_tick(*a, **kw):
+        time.sleep(delay)
+        return orig_tick(*a, **kw)
+
+    srv.engine.tick = _slow_tick
+
+
+_TTFT_RULE = {"name": "ttft-p95", "kind": "quantile",
+              "metric": "serve_ttft_seconds", "q": 0.95,
+              "target": 0.05, "window_s": 3.0}
+
+
+def _scenario_zipf_mix(rounds, seed, host):
+    """Zipf-popularity request catalog over a 2-replica fleet: a few
+    head prompts dominate, the tail is long.  Greedy decode is a
+    fleet-wide contract — every repeat of a catalog prompt must produce
+    the IDENTICAL token stream no matter which replica served it — and
+    the obs counters must account for every request."""
+    from distlearn_tpu.serve.router import Router
+    params = _lm_params()
+    port = _reserve_window(2, host)
+    servers = _spawn_replicas(host, port, 2, params)
+    catalog = _serve_prompts(10, seed)
+    weights = 1.0 / np.arange(1, 11) ** 1.5
+    weights /= weights.sum()
+    total = rounds * 3
+    idx = np.random.default_rng(seed).choice(10, size=total, p=weights)
+    try:
+        with Router([(host, port + i) for i in range(2)],
+                    health_ttl=0.05, dial_deadline=1.0) as router:
+            results, hung = _fleet_load(
+                router, [catalog[int(k)] for k in idx], 4, stagger=0.01)
+    finally:
+        _stop_replicas(servers)
+    snap = core.REGISTRY.snapshot()
+    dispatched = _labeled(snap, "router_dispatch_total")
+    outcomes = _labeled(snap, "serve_requests_total")
+    done = [r for r in results
+            if isinstance(r, dict) and r["reason"] == "complete"]
+    failures = []
+    if hung:
+        failures.append(f"{hung} request thread(s) hung")
+    if len(done) != total:
+        bad = [r for r in results if r not in done]
+        failures.append(f"only {len(done)}/{total} completed: {bad[:3]!r}")
+    streams: dict[int, set] = {}
+    for k, r in zip(idx, results):
+        if isinstance(r, dict) and r["reason"] == "complete":
+            streams.setdefault(int(k), set()).add(tuple(r["tokens"]))
+    skewed = {k: len(v) for k, v in streams.items() if len(v) != 1}
+    if skewed:
+        failures.append("replicas disagreed on repeated prompts "
+                        f"(prompt -> distinct streams): {skewed}")
+    if len(dispatched) < 2:
+        failures.append("the mix never spread past one replica")
+    counts = np.bincount(idx, minlength=10)
+    if counts.max() < total / 4:
+        failures.append(f"the zipf draw lost its head: {counts.tolist()}")
+    completed_ctr = sum(v for lbl, v in outcomes.items()
+                        if "complete" in str(lbl))
+    if completed_ctr != len(done):
+        failures.append(f"serve_requests_total{{complete}} = "
+                        f"{completed_ctr} != {len(done)} completions")
+    return {"requests": total, "completed": len(done),
+            "head_share": round(float(counts.max()) / total, 3),
+            "distinct_prompts": int((counts > 0).sum()),
+            "replicas_dispatched": len(dispatched)}, failures
+
+
+def _scenario_diurnal(rounds, seed, host):
+    """A one-day sine of wave sizes (trough 1 -> peak 8 -> trough 1)
+    against a single throttled replica, with the full telemetry loop
+    watching: export endpoint -> Collector -> windowed TTFT-p95 SLO.
+    The peak waves must breach (queueing behind the throttled ticks is
+    deterministic), and once the trough traffic leaves the window the
+    rule must recover — both transitions counted."""
+    from distlearn_tpu.obs import agg as obs_agg
+    from distlearn_tpu.obs.export import start_http_server
+    from distlearn_tpu.serve.router import Router
+    params = _lm_params()
+    port = _reserve_window(1, host)
+    (srv,) = _spawn_replicas(host, port, 1, params, num_slots=2)
+    _throttle_ticks(srv, 0.02)
+    exp = start_http_server(0, host)
+    collector = obs_agg.Collector(endpoints=[(host, exp.port)])
+    slo = obs_agg.SLOEngine([dict(_TTFT_RULE)])
+    peak = 8
+    curve = [1 + int(round((peak - 1) * 0.5 *
+                           (1 - math.cos(2 * math.pi * p / rounds))))
+             for p in range(rounds)]
+    results: list = []
+    hung_total = 0
+    phase_ok: list[bool] = []
+    failures: list = []
+    try:
+        with Router([(host, port)], health_ttl=0.05,
+                    dial_deadline=1.0) as router:
+            for p, lvl in enumerate(curve):
+                out, hung = _fleet_load(
+                    router, _serve_prompts(lvl, seed + p), 4,
+                    stagger=0.005)
+                results.extend(out)
+                hung_total += hung
+                phase_ok.append(slo.evaluate(collector.poll())[0]["ok"])
+            deadline = time.monotonic() + CHAOS_SETTLE_S
+            while time.monotonic() < deadline:
+                if slo.evaluate(collector.poll())[0]["ok"]:
+                    break
+                time.sleep(0.1)
+            else:
+                failures.append("the windowed TTFT SLO never recovered "
+                                "after the trough")
+    finally:
+        exp.close()
+        _stop_replicas([srv])
+    total = sum(curve)
+    totals = _totals(core.REGISTRY.snapshot())
+    done = [r for r in results
+            if isinstance(r, dict) and r["reason"] == "complete"]
+    if hung_total:
+        failures.append(f"{hung_total} request thread(s) hung")
+    if len(done) != total:
+        failures.append(f"only {len(done)}/{total} completed")
+    if all(phase_ok[p] for p, lvl in enumerate(curve) if lvl == peak):
+        failures.append(f"no peak wave (size {peak}) breached the SLO: "
+                        f"curve={curve} ok={phase_ok}")
+    if totals.get("slo_breaches_total", 0) < 1:
+        failures.append("slo_breaches_total never fired")
+    if totals.get("slo_recoveries_total", 0) < 1:
+        failures.append("slo_recoveries_total never fired")
+    fleet_ttft = collector.fleet.histogram("serve_ttft_seconds")
+    if not fleet_ttft or fleet_ttft["count"] != total:
+        failures.append(f"fleet TTFT histogram count "
+                        f"{fleet_ttft and fleet_ttft['count']} != {total}")
+    return {"requests": total, "completed": len(done), "curve": curve,
+            "phases_breached": sum(1 for ok in phase_ok if not ok),
+            "breaches": totals.get("slo_breaches_total", 0),
+            "recoveries": totals.get("slo_recoveries_total", 0)}, failures
+
+
+def _scenario_flash_crowd(rounds, seed, host):
+    """The autoscaler acceptance run: a 10x request burst against a
+    one-replica fleet wired to the obs-driven autoscaler
+    (tools/autoscaler.py).  The windowed TTFT breach must scale the
+    fleet up mid-burst, the spawned replica must take real dispatches,
+    every request must complete, and once the crowd passes the rule
+    must recover and cooldown must retire the fleet back to one
+    replica."""
+    tooldir = os.path.dirname(os.path.abspath(__file__))
+    if tooldir not in sys.path:
+        sys.path.insert(0, tooldir)
+    from autoscaler import Actuator, Autoscaler
+    from distlearn_tpu.obs import agg as obs_agg
+    from distlearn_tpu.obs.export import start_http_server
+    from distlearn_tpu.serve.router import Router
+    params = _lm_params()
+    port = _reserve_window(3, host)
+    tick_s = 0.05
+    (base_srv,) = _spawn_replicas(host, port, 1, params, num_slots=2)
+    _throttle_ticks(base_srv, tick_s)
+    exp = start_http_server(0, host)
+    collector = obs_agg.Collector(endpoints=[(host, exp.port)])
+    rule = dict(_TTFT_RULE, target=0.1, window_s=2.5)
+    slo = obs_agg.SLOEngine([rule])
+    extra: list = []
+    failures: list = []
+    baseline = max(2, rounds // 5)
+    burst = baseline * 10
+    try:
+        with Router([(host, port)], health_ttl=0.02,
+                    dial_deadline=1.0) as router:
+
+            def _spawn():
+                p = port + 1 + len(extra)
+                (srv,) = _spawn_replicas(host, p, 1, params, num_slots=2)
+                _throttle_ticks(srv, tick_s)
+                extra.append(srv)
+                return (srv, router.add_replica(host, p))
+
+            def _retire(handle):
+                srv, name = handle
+                router.remove_replica(name)
+                srv.stop()
+
+            # warm the decode path first: the first-admit jit compile
+            # counts as a TTFT sample, and a compile-second sample must
+            # leave the window before the scaler is armed or it would
+            # scale on warmup, not on the crowd
+            _fleet_load(router, _serve_prompts(2, seed + 7), 4)
+            deadline = time.monotonic() + CHAOS_SETTLE_S
+            while time.monotonic() < deadline:
+                if slo.evaluate(collector.poll())[0]["ok"]:
+                    break
+                time.sleep(0.1)
+            else:
+                failures.append("warmup TTFT never left the SLO window")
+            snap0 = _totals(core.REGISTRY.snapshot())
+
+            scaler = Autoscaler(
+                collector, slo,
+                Actuator(spawn=_spawn, retire=_retire, min_size=1,
+                         max_size=3, initial=1),
+                scale_on={rule["name"]}, cooldown_s=1.0)
+
+            # baseline: light load, the scaler must hold at one replica
+            pre, hung_pre = _fleet_load(
+                router, _serve_prompts(baseline, seed), 4, stagger=0.05)
+            report = scaler.step()
+            if report["action"] != "hold" or report["size"] != 1:
+                failures.append(f"baseline load moved the scaler: "
+                                f"{report['action']} -> {report['size']}")
+
+            # flash crowd: 10x the baseline wave.  Two constraints pick
+            # the shape: arrivals must exceed the one-replica drain rate
+            # (2 slots per tick_s => ~2/(9*tick_s) req/s at 8 tokens
+            # each) so the queue really builds and TTFT really breaches,
+            # AND the submit window must outlive the scaler's reaction
+            # (~poll interval + one breach-visible TTFT sample) so the
+            # spawned replica still has arrivals left to dispatch —
+            # requests route at submit time, not from a shared queue
+            box: dict = {}
+
+            def _crowd():
+                box["out"] = _fleet_load(
+                    router, _serve_prompts(burst, seed + 1), 8,
+                    stagger=0.15)
+
+            crowd = threading.Thread(target=_crowd, daemon=True)
+            crowd.start()
+            peak_size = 1
+            while crowd.is_alive():
+                peak_size = max(peak_size, scaler.step()["size"])
+                time.sleep(0.1)
+            crowd.join(CHAOS_RECOVER_S)
+            results, hung = box.get("out", ([], burst))
+
+            # aftermath: keep the loop running until the SLO recovers
+            # and cooldown retires the fleet back to baseline
+            deadline = time.monotonic() + CHAOS_SETTLE_S
+            while time.monotonic() < deadline:
+                report = scaler.step()
+                if report["size"] == 1 and report["events"][0]["ok"]:
+                    break
+                time.sleep(0.1)
+            else:
+                failures.append(
+                    f"fleet never cooled back down: size "
+                    f"{report['size']}, ok {report['events'][0]['ok']}")
+            left = router.replica_names()
+    finally:
+        exp.close()
+        _stop_replicas([base_srv] + extra)
+    snap = core.REGISTRY.snapshot()
+    totals = _totals(snap)
+    scale_events = _labeled(snap, "autoscaler_scale_events_total")
+    ups = sum(v for lbl, v in scale_events.items() if "up" in str(lbl))
+    downs = sum(v for lbl, v in scale_events.items()
+                if "down" in str(lbl))
+    dispatched = _labeled(snap, "router_dispatch_total")
+    done = [r for r in results
+            if isinstance(r, dict) and r["reason"] == "complete"]
+    pre_done = [r for r in pre
+                if isinstance(r, dict) and r["reason"] == "complete"]
+    if hung_pre or hung:
+        failures.append(f"{hung_pre + hung} request thread(s) hung")
+    if len(pre_done) != baseline or len(done) != burst:
+        failures.append(f"completions {len(pre_done)}+{len(done)} != "
+                        f"{baseline}+{burst}")
+    if peak_size < 2 or ups < 1:
+        failures.append(f"the crowd never scaled the fleet up "
+                        f"(peak {peak_size}, ups {ups})")
+    if downs < 1:
+        failures.append("cooldown never retired a replica")
+    if len(left) != 1:
+        failures.append(f"{len(left)} replicas left in the router, "
+                        "want the baseline 1")
+    if len(dispatched) < 2:
+        failures.append("no dispatch ever landed on a spawned replica")
+    breaches = totals.get("slo_breaches_total", 0) \
+        - snap0.get("slo_breaches_total", 0)
+    recoveries = totals.get("slo_recoveries_total", 0) \
+        - snap0.get("slo_recoveries_total", 0)
+    if breaches < 1:
+        failures.append("the flash crowd never breached the SLO")
+    if recoveries < 1:
+        failures.append("the SLO never recovered after the crowd")
+    return {"baseline": baseline, "burst": burst,
+            "completed": len(pre_done) + len(done),
+            "peak_size": peak_size, "scale_ups": ups,
+            "scale_downs": downs, "breaches": breaches,
+            "recoveries": recoveries,
+            "replicas_dispatched": len(dispatched)}, failures
+
+
 _SCENARIOS = {
     "flash_join": _scenario_flash_join,
     "rolling_leave": _scenario_rolling_leave,
@@ -1239,6 +1563,9 @@ _SCENARIOS = {
     "slow_replica": _scenario_slow_replica,
     "overload_shed": _scenario_overload_shed,
     "swap_during_traffic": _scenario_swap_during_traffic,
+    "zipf_mix": _scenario_zipf_mix,
+    "diurnal": _scenario_diurnal,
+    "flash_crowd": _scenario_flash_crowd,
 }
 
 

@@ -9,6 +9,7 @@ docs/PERF.md used to recompute by hand:
     python tools/diststat.py summarize run.jsonl [more.jsonl ...]
     python tools/diststat.py summarize run.jsonl --format json
     python tools/diststat.py diff before.jsonl after.jsonl
+    python tools/diststat.py merge center.jsonl client-*.jsonl
 
 ``summarize`` reports per-span-name count/p50/p95/p99/total (exact —
 computed from the individual span durations, not histogram buckets),
@@ -18,6 +19,15 @@ sum across files (one file per process is the normal layout — server
 and each client spill separately).  ``diff`` subtracts run A's counter
 totals and span quantiles from run B's.
 
+``merge`` is the FLEET view (one trail per process): counters and span
+quantiles fleet-wide with a per-process breakdown column, histogram
+merges through ``obs.agg`` (the same math the live Collector runs),
+the SLO table (rule state, breach/recovery counts), the autoscaler
+table (target size, scale events by direction), per-process obs health
+(``obs_spans_dropped_total`` — nonzero means the 4096-entry span ring
+wrapped and this report undercounts), and the chronological fleet
+event log (``slo.breach`` / ``slo.recover`` / ``autoscaler.scale_*``).
+
 Record schema: docs/OBSERVABILITY.md.
 """
 
@@ -25,7 +35,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def _percentile(xs: list[float], q: float) -> float:
@@ -447,6 +460,201 @@ def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
     return {"counters": counters, "spans": spans, "wire": wire}
 
 
+_EVENT_SPANS = ("slo.breach", "slo.recover",
+                "autoscaler.scale_up", "autoscaler.scale_down")
+
+
+def _load_trail(path: str) -> tuple[list[dict], dict | None]:
+    """(span records, last snapshot record) of one trail — the raw
+    records, unlike :func:`load_run`'s digested durations, because the
+    fleet view needs timestamps and labels for the event log."""
+    spans: list[dict] = []
+    last = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue          # torn tail line of a live run
+            if rec.get("type") == "span":
+                spans.append(rec)
+            elif rec.get("type") == "snapshot":
+                last = rec
+    return spans, last
+
+
+def _by_label(fam: dict | None, label: str) -> dict:
+    out: dict = {}
+    for s in (fam or {}).get("samples", []):
+        v = (s.get("labels") or {}).get(label)
+        if v is not None:
+            out[v] = out.get(v, 0) + s.get("value", 0)
+    return out
+
+
+def merge_runs(paths: list[str]) -> dict:
+    """Fleet view over one trail per process: merged counters/spans/
+    histograms with per-process breakdowns, the SLO and autoscaler
+    tables, per-process obs health, and the chronological event log.
+    Merging runs through ``obs.agg.FleetRegistry`` — the same math the
+    live Collector applies, so this offline report and the in-flight
+    SLO engine can never disagree about fleet totals."""
+    from distlearn_tpu.obs import agg
+    fleet = agg.FleetRegistry()
+    sources: list[str] = []
+    span_by_src: dict[str, list[dict]] = {}
+    events: list[dict] = []
+    for path in paths:
+        src = os.path.basename(path)
+        if src in span_by_src:          # two processes, one basename
+            src = path
+        sources.append(src)
+        spans, snap = _load_trail(path)
+        span_by_src[src] = spans
+        if snap is not None:
+            fleet.ingest(snap, source=src)
+        for rec in spans:
+            if rec.get("name") in _EVENT_SPANS:
+                events.append({"ts": rec.get("ts", 0.0),
+                               "event": rec["name"], "src": src,
+                               **(rec.get("labels") or {})})
+    events.sort(key=lambda e: e["ts"])
+    merged = fleet.merged()
+
+    counters: dict[str, dict] = {}
+    gauges: dict[str, dict] = {}
+    hists: dict[str, dict] = {}
+    for name, fam in sorted(merged.items()):
+        by = fleet.breakdown(name)
+        if fam["kind"] == "counter":
+            counters[name] = {"total": sum(by.values()), "by": by}
+        elif fam["kind"] == "gauge":
+            gauges[name] = {"by": by}
+        else:
+            # a family can be registered but never observed — no samples
+            h = fleet.histogram(name) or {"count": 0, "sum": 0.0}
+            hists[name] = {
+                "count": h["count"],
+                "mean": h["sum"] / h["count"] if h["count"]
+                else float("nan"),
+                "by": by}
+
+    span_tab: dict[str, dict] = {}
+    durs_by_name: dict[str, list[float]] = {}
+    for src, recs in span_by_src.items():
+        for rec in recs:
+            name = rec.get("name", "?")
+            durs_by_name.setdefault(name, []).append(
+                float(rec.get("dur", 0.0)))
+            row = span_tab.setdefault(name, {"count": 0, "by": {}})
+            row["count"] += 1
+            row["by"][src] = row["by"].get(src, 0) + 1
+    for name, row in span_tab.items():
+        durs = durs_by_name[name]
+        row.update(p50=_percentile(durs, 50), p95=_percentile(durs, 95),
+                   p99=_percentile(durs, 99), total=sum(durs))
+
+    slo_tab: dict[str, dict] = {}
+    ok = _by_label(merged.get("slo_ok"), "slo")
+    val = _by_label(merged.get("slo_value"), "slo")
+    breaches = _by_label(merged.get("slo_breaches_total"), "slo")
+    recoveries = _by_label(merged.get("slo_recoveries_total"), "slo")
+    for rule in sorted(set(ok) | set(breaches) | set(recoveries)):
+        slo_tab[rule] = {"ok": bool(ok.get(rule, 1)),
+                         "value": val.get(rule, float("nan")),
+                         "breaches": breaches.get(rule, 0),
+                         "recoveries": recoveries.get(rule, 0)}
+
+    scaler_tab: dict = {}
+    scale_events = _by_label(
+        merged.get("autoscaler_scale_events_total"), "direction")
+    if scale_events or "autoscaler_target_size" in gauges:
+        scaler_tab = {"events": scale_events,
+                      "target_size": max(
+                          gauges.get("autoscaler_target_size",
+                                     {}).get("by", {}).values(),
+                          default=float("nan"))}
+
+    health: dict[str, dict] = {}
+    dropped = fleet.breakdown("obs_spans_dropped_total")
+    failures = fleet.breakdown("obs_agg_poll_failures_total")
+    for src in sources:
+        row = {}
+        if src in dropped:
+            row["spans_dropped"] = dropped[src]
+        if src in failures:
+            row["poll_failures"] = failures[src]
+        if row:
+            health[src] = row
+
+    return {"sources": sources, "counters": counters, "gauges": gauges,
+            "histograms": hists, "spans": span_tab, "slo": slo_tab,
+            "autoscaler": scaler_tab, "obs_health": health,
+            "events": events}
+
+
+def _fmt_by(by: dict) -> str:
+    return " ".join(f"{src}={v:g}" for src, v in sorted(by.items()))
+
+
+def _print_merge(doc: dict):
+    print(f"fleet of {len(doc['sources'])}: "
+          + ", ".join(doc["sources"]) + "\n")
+    if doc["spans"]:
+        print(f"{'span':<32} {'count':>7} {'p50':>10} {'p95':>10} "
+              f"{'p99':>10}  per-process")
+        for name, row in sorted(doc["spans"].items()):
+            print(f"{name:<32} {row['count']:>7} "
+                  f"{_fmt_s(row['p50']):>10} {_fmt_s(row['p95']):>10} "
+                  f"{_fmt_s(row['p99']):>10}  {_fmt_by(row['by'])}")
+        print()
+    if doc["counters"]:
+        print(f"{'counter':<40} {'fleet':>10}  per-process")
+        for name, row in doc["counters"].items():
+            print(f"{name:<40} {row['total']:>10g}  "
+                  f"{_fmt_by(row['by'])}")
+        print()
+    if doc["histograms"]:
+        print(f"{'histogram':<40} {'count':>8} {'mean':>10}  per-process")
+        for name, row in doc["histograms"].items():
+            print(f"{name:<40} {row['count']:>8g} "
+                  f"{_fmt_s(row['mean']):>10}  {_fmt_by(row['by'])}")
+        print()
+    if doc["slo"]:
+        print(f"{'slo rule':<24} {'state':>8} {'value':>10} "
+              f"{'breaches':>9} {'recoveries':>11}")
+        for rule, row in doc["slo"].items():
+            state = "ok" if row["ok"] else "BREACH"
+            print(f"{rule:<24} {state:>8} {row['value']:>10.4g} "
+                  f"{row['breaches']:>9g} {row['recoveries']:>11g}")
+        print()
+    if doc["autoscaler"]:
+        a = doc["autoscaler"]
+        ev = " ".join(f"{d}={v:g}"
+                      for d, v in sorted(a["events"].items()))
+        print(f"autoscaler: target_size={a['target_size']:g} "
+              f"events[{ev}]")
+        print()
+    for src, row in doc["obs_health"].items():
+        if row.get("spans_dropped"):
+            print(f"WARNING: {src} dropped {row['spans_dropped']:g} span "
+                  "records (ring wrapped) — span tables undercount")
+        if row.get("poll_failures"):
+            print(f"WARNING: {src} had {row['poll_failures']:g} collector "
+                  "poll failures — fleet totals may lag")
+    if doc["events"]:
+        print("fleet events:")
+        t0 = doc["events"][0]["ts"]
+        for e in doc["events"]:
+            extra = " ".join(f"{k}={v}" for k, v in sorted(e.items())
+                             if k not in ("ts", "event", "src"))
+            print(f"  +{e['ts'] - t0:8.3f}s  {e['event']:<22} {extra}  "
+                  f"[{e['src']}]")
+
+
 def _fmt_s(v: float) -> str:
     if v != v:
         return "nan"
@@ -458,6 +666,10 @@ def _fmt_s(v: float) -> str:
 
 
 def _print_summary(doc: dict):
+    dropped = doc["counter_totals"].get("obs_spans_dropped_total", 0)
+    if dropped:
+        print(f"WARNING: the span ring dropped {dropped:g} records "
+              "(trail truncated) — span tables undercount\n")
     if doc["spans"]:
         print(f"{'span':<40} {'count':>7} {'p50':>10} {'p95':>10} "
               f"{'p99':>10} {'total':>10} {'err':>5}")
@@ -610,6 +822,10 @@ def main(argv=None) -> int:
     pd.add_argument("a")
     pd.add_argument("b")
     pd.add_argument("--format", choices=("text", "json"), default="text")
+    pm = sub.add_parser("merge", help="fleet view: one trail per "
+                                      "process, per-process breakdowns")
+    pm.add_argument("paths", nargs="+")
+    pm.add_argument("--format", choices=("text", "json"), default="text")
     args = p.parse_args(argv)
     if args.cmd is None:
         p.print_usage(sys.stderr)
@@ -617,6 +833,8 @@ def main(argv=None) -> int:
     try:
         if args.cmd == "summarize":
             doc = summarize_run(args.paths)
+        elif args.cmd == "merge":
+            doc = merge_runs(args.paths)
         else:
             doc = diff_runs([args.a], [args.b])
     except OSError as e:
@@ -626,6 +844,8 @@ def main(argv=None) -> int:
         print(json.dumps(doc, indent=2, sort_keys=True))
     elif args.cmd == "summarize":
         _print_summary(doc)
+    elif args.cmd == "merge":
+        _print_merge(doc)
     else:
         _print_diff(doc)
     return 0
