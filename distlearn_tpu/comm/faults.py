@@ -373,7 +373,8 @@ class FaultPlan:
             st.send_blocked = st.recv_blocked = False
             st.send_delay_s = st.recv_delay_s = 0.0
             st.recv_bps = None
-        for c in self._conns.get(link, []):
+            conns = list(self._conns.get(link, []))
+        for c in conns:
             c.throttle_bps = None
         self._note(link, "heal")
 
@@ -392,7 +393,9 @@ class FaultPlan:
         benches with); the recv direction is paced in the proxy."""
         st = self._link(link)
         if direction in ("send", "both"):
-            for c in self._conns.get(link, []):
+            with self._lock:
+                conns = list(self._conns.get(link, []))
+            for c in conns:
                 c.throttle_bps = float(bps)
         if direction in ("recv", "both"):
             with self._lock:

@@ -11,7 +11,15 @@ re-derives the numbers on every run and compares:
 * **DL204** — compiled peak memory exceeds the committed figure by more
   than ``tolerance.memory``;
 * **DL205** — the post-fusion op count for any kind exceeds the
-  committed count (integer, no tolerance: fusion either held or broke).
+  committed count (integer, no tolerance: fusion either held or broke);
+* **DL207** — the family's distinct-compile count (one per distinct
+  dtype/weak-type/shape signature across its units) exceeds the
+  committed ``compiles.count`` — a new prefill bucket or an accidental
+  retrace adds warmup tail and must land with a conscious re-baseline;
+* **DL208** — a unit's entry relayout op count (``copy``/``transpose``
+  of an entry parameter in the compiled ENTRY computation) exceeds the
+  committed ``relayout_ops`` (integer, no tolerance: the entry layout
+  contract either held or broke).
 
 A family with cost-bearing units and *no* committed lockfile — or a unit
 missing from the lockfile — is a DL203 error: every perf-relevant change
@@ -70,6 +78,12 @@ def save_budget(family: str, reports: Mapping[str, CostReport],
         "units": {name: rep.to_json() for name, rep in sorted(
             reports.items())},
     }
+    signatures = {rep.signature for rep in reports.values()
+                  if rep.signature is not None}
+    if signatures:
+        # DL207 gate: the family's distinct-compile count.  compile_s is
+        # wall-clock and nondeterministic, so it never enters the lockfile.
+        doc["compiles"] = {"count": len(signatures)}
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
         fh.write("\n")
@@ -147,4 +161,27 @@ def check_family(family: str, reports: Mapping[str, CostReport],
                 f"committed {committed_peak} bytes by more than "
                 f"{tol['memory']:.0%}",
                 where=name))
+        committed_relayouts = entry.get("relayout_ops")
+        if committed_relayouts is not None and rep.relayout_ops is not None \
+                and rep.relayout_ops > committed_relayouts:
+            findings.append(Finding(
+                "DL208",
+                f"{rep.relayout_ops} entry relayout op(s) (copy/transpose "
+                f"of an entry parameter) vs {committed_relayouts} committed "
+                "— the compiled program re-materializes an argument in a "
+                "different layout on every dispatch; fix the caller-side "
+                "layout or re-baseline with --update-budgets",
+                where=name))
+    committed_compiles = budget.get("compiles", {}).get("count")
+    if committed_compiles is not None:
+        fresh = len({rep.signature for rep in reports.values()
+                     if rep.signature is not None})
+        if fresh > committed_compiles:
+            findings.append(Finding(
+                "DL207",
+                f"family {family!r} now lowers {fresh} distinct programs "
+                f"vs {committed_compiles} committed — a new bucket or a "
+                "dtype/weak-type retrace added warmup tail; remove the "
+                "extra lowering or re-baseline with --update-budgets",
+                where=family))
     return findings

@@ -39,6 +39,16 @@ pins the two together:
 ``lint_conformance(schedules=..., source=...)`` accepts overrides so the
 seeded-mutation tests can feed in an edited schedule or edited module
 source and assert DL310 fires.
+
+Serve-frame field conformance (:func:`lint_serve_frames`) extends the
+same discipline to the serving wire: every field the 'J' health-probe
+reply, the 'G' generate request, and the 'R' stream chunk carry must be
+bound in :data:`SERVE_FRAME_BINDINGS`, and every binding must still
+show producer-or-consumer evidence in ``serve/server.py`` /
+``serve/router.py`` / ``serve/client.py``.  The check is bidirectional:
+a NEW field shipped without a binding is DL310 (undocumented wire
+surface), and a binding whose field vanished from the code is DL310
+stale (the table would lie to the next reader).
 """
 
 from __future__ import annotations
@@ -49,7 +59,8 @@ from typing import Mapping
 
 from distlearn_tpu.lint.core import Finding
 
-__all__ = ["lint_conformance", "TAG_BINDINGS", "KNOWN_UNMODELED"]
+__all__ = ["lint_conformance", "lint_serve_frames", "TAG_BINDINGS",
+           "KNOWN_UNMODELED", "SERVE_FRAME_BINDINGS"]
 
 #: tag -> (kind, detail).  Kinds:
 #:   "const"     — wire constant in async_ea.py; detail = const name;
@@ -329,4 +340,260 @@ def lint_conformance(*, schedules: Mapping | None = None,
             "announce no longer stamps (and the admit path no longer "
             "adopts) the trace context the wire format documents",
             where="async_ea._announce"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Serve-frame field conformance ('J' / 'G' / 'R' wire frames)
+# ---------------------------------------------------------------------------
+
+#: frame kind -> {field: what it carries}.  The audited evidence is the
+#: union of producer writes and consumer reads across server/router/
+#: client; both directions are checked (new-field-unbound AND
+#: stale-binding fire DL310).
+SERVE_FRAME_BINDINGS: dict = {
+    "J": {
+        "q": "control request verb ('stats') from router._probe / "
+             "client.ping",
+        "ok": "reply envelope flag stamped by the server's J handler",
+        "serving": "loop-alive flag; router._live gates dispatch on it",
+        "failed": "death reason latch; router._live treats it as down",
+        "draining": "checkpoint drain latch; router skips draining "
+                    "replicas",
+        "queue_depth": "admission backlog; router load-balances and "
+                       "sheds on it",
+        "active": "occupied decode slots; router's least-loaded score",
+        "free_pages": "KV pool headroom (capacity telemetry)",
+        "epoch": "serving weights epoch; router's fleet epoch view",
+        "ckpt_step": "checkpoint step of the serving weights",
+        "swap_pending": "two-phase hot-swap in progress",
+    },
+    "G": {
+        "prompt": "token ids to prefill",
+        "max_new": "decode budget",
+        "rid": "caller-chosen request id (optional)",
+        "deadline_s": "per-request deadline (optional)",
+        "eos": "early-stop token id (optional)",
+        "tc": "cross-process trace context (obs.trace.TRACE_KEY)",
+    },
+    "R": {
+        "rid": "request id echo (stream demux on shared conns)",
+        "tokens": "tokens decoded this scheduling round",
+        "done": "terminal-chunk flag",
+        "epoch": "serving epoch echo — the hot-swap fence witness",
+        "reason": "terminal reason (complete/eos/deadline/...)",
+        "error": "rejection/abort message (error chunks only)",
+        "queue_depth": "backlog at rejection time (shed hint)",
+        "retry_after": "shed backoff hint in seconds",
+    },
+}
+
+
+def _dict_const_keys(d) -> set:
+    """Constant keys of one dict literal (``**spread`` keys are None)."""
+    return {k.value for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+
+
+def _get_key(call) -> str | None:
+    """``X.get("field")`` / ``X.get(obs_trace.TRACE_KEY)`` -> field."""
+    if not (isinstance(call, ast.Call) and isinstance(call.func,
+                                                      ast.Attribute)
+            and call.func.attr == "get" and call.args):
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value
+    if isinstance(a, ast.Attribute) and a.attr == "TRACE_KEY":
+        return "tc"
+    return None
+
+
+def _sub_key(sub) -> str | None:
+    """``X["field"]`` / ``X[obs_trace.TRACE_KEY]`` -> field."""
+    s = sub.slice
+    if isinstance(s, ast.Constant) and isinstance(s.value, str):
+        return s.value
+    if isinstance(s, ast.Attribute) and s.attr == "TRACE_KEY":
+        return "tc"
+    return None
+
+
+def _send_msg_dict_keys(tree) -> set:
+    out: set = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "send_msg" and node.args
+                and isinstance(node.args[0], ast.Dict)):
+            out |= _dict_const_keys(node.args[0])
+    return out
+
+
+def _health_reply_keys(tree) -> set:
+    """Constant keys of the ``health()`` return dict — the payload the
+    server's J handler spreads into its reply."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "health":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and isinstance(sub.value,
+                                                              ast.Dict):
+                    return _dict_const_keys(sub.value)
+    return set()
+
+
+def _stream_chunk_keys(tree) -> set:
+    """Fields of every 'R' chunk a server function builds: dict-literal
+    keys plus constant subscript stores, in functions that send_stream."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sends = any(isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "send_stream"
+                    for n in ast.walk(node))
+        if not sends:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                out |= _dict_const_keys(sub)
+            elif (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.ctx, ast.Store)
+                    and isinstance(sub.value, ast.Name)):
+                k = _sub_key(sub)
+                if k is not None:
+                    out.add(k)
+    return out
+
+
+def _g_request_keys(tree) -> set:
+    """Fields of the 'G' request ``msg`` dict a caller builds: the
+    literal assignment plus the optional-field subscript stores."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id == "msg"
+                        and isinstance(node.value, ast.Dict)):
+                    out |= _dict_const_keys(node.value)
+        elif (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Store)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "msg"):
+            k = _sub_key(node)
+            if k is not None:
+                out.add(k)
+    return out
+
+
+def _name_field_reads(tree, varname: str) -> set:
+    """``X.get("f")`` and ``X["f"]`` loads on the local name ``X``."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == varname):
+            k = _get_key(node)
+            if k is not None:
+                out.add(k)
+        elif (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == varname):
+            k = _sub_key(node)
+            if k is not None:
+                out.add(k)
+    return out
+
+
+def _health_snapshot_reads(tree) -> set:
+    """'J'-reply fields the router consumes: ``.get("f")`` where the
+    receiver mentions a ``health`` attribute (``(rep.health or
+    {}).get(...)``) or is the conventional ``h`` local, plus the
+    dict-comprehension sweep ``{k: (r.health or {}).get(k) for k in
+    ("queue_depth", ...)}``."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            k = _get_key(node)
+            if k is not None:
+                recv = node.func.value
+                mentions_health = any(
+                    isinstance(n, ast.Attribute) and n.attr == "health"
+                    for n in ast.walk(recv))
+                if mentions_health or (isinstance(recv, ast.Name)
+                                       and recv.id == "h"):
+                    out.add(k)
+        elif isinstance(node, ast.DictComp):
+            # value reads X.get(k) with the comprehension variable
+            v = node.value
+            if (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr == "get" and v.args
+                    and isinstance(v.args[0], ast.Name)
+                    and node.generators
+                    and isinstance(node.generators[0].iter, ast.Tuple)):
+                out |= {e.value for e in node.generators[0].iter.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+    return out
+
+
+def lint_serve_frames(*, server_source: str | None = None,
+                      router_source: str | None = None,
+                      client_source: str | None = None) -> list[Finding]:
+    """DL310 audit of the serving wire frames ('J'/'G'/'R').
+
+    Collects per-frame field evidence — producer writes in
+    ``server.py`` (R chunks, J reply) and ``router.py``/``client.py``
+    (G request, J probe), consumer reads on the other side — and diffs
+    the union against :data:`SERVE_FRAME_BINDINGS` in BOTH directions.
+    Source overrides feed the seeded-mutation tests.
+    """
+    if server_source is None:
+        from distlearn_tpu.serve import server
+        server_source = inspect.getsource(server)
+    if router_source is None:
+        from distlearn_tpu.serve import router
+        router_source = inspect.getsource(router)
+    if client_source is None:
+        from distlearn_tpu.serve import client
+        client_source = inspect.getsource(client)
+    srv = ast.parse(server_source)
+    rtr = ast.parse(router_source)
+    cli = ast.parse(client_source)
+
+    evidence = {
+        "J": (_send_msg_dict_keys(srv) | _health_reply_keys(srv)
+              | _send_msg_dict_keys(rtr) | _send_msg_dict_keys(cli)
+              | _health_snapshot_reads(rtr)),
+        "G": (_g_request_keys(rtr) | _g_request_keys(cli)
+              | _name_field_reads(srv, "msg")),
+        "R": (_stream_chunk_keys(srv)
+              | _name_field_reads(rtr, "chunk")
+              | _name_field_reads(cli, "chunk")),
+    }
+    findings: list[Finding] = []
+    for kind in sorted(SERVE_FRAME_BINDINGS):
+        bound = SERVE_FRAME_BINDINGS[kind]
+        seen = evidence[kind]
+        for fieldname in sorted(seen - set(bound)):
+            findings.append(Finding(
+                "DL310",
+                f"'{kind}' frame field {fieldname!r} appears in the serve "
+                "wire code but has no SERVE_FRAME_BINDINGS entry — new "
+                "wire surface must be bound (with what it carries) or it "
+                "ships undocumented",
+                where=f"serve_frames.{kind}.{fieldname}"))
+        for fieldname in sorted(set(bound) - seen):
+            findings.append(Finding(
+                "DL310",
+                f"'{kind}' frame binding {fieldname!r} has no remaining "
+                "producer or consumer in server/router/client — the "
+                "binding table drifted from the wire (remove the entry "
+                "or restore the field)",
+                where=f"serve_frames.{kind}.{fieldname}"))
     return findings

@@ -35,6 +35,17 @@ RULES = {
               "lockfile", "error"),
     "DL205": ("post-fusion collective op count regressed vs. the family's "
               "budget lockfile", "error"),
+    "DL206": ("serve-path donation wasted (declared but not aliased by the "
+              "compiled program) or missing (large aliasable pool left "
+              "undonated)", "error"),
+    "DL207": ("distinct-compile count exceeds the family's committed budget "
+              "(new bucket or dtype/weak-type retrace adds warmup tail)",
+              "error"),
+    "DL208": ("compiled program relayouts an entry parameter (host-visible "
+              "copy/transpose at jitted-program entry) beyond the committed "
+              "budget", "error"),
+    "DL209": ("per-tick Python-level tensor math outside the jitted tick "
+              "program (serve hot-loop host work)", "error"),
     "DL101": ("host send/recv schedule admits a wait-for cycle "
               "(static deadlock)", "error"),
     "DL102": ("lock acquisition order forms a cycle across threads",

@@ -33,12 +33,50 @@ step.  Two rules fire directly from the model:
 
 Budget regression rules DL203-DL205 compare a :class:`CostReport` against
 the committed per-family lockfiles — see :mod:`distlearn_tpu.lint.budget`.
+
+Serve-path performance rules (DL206-DL209)
+------------------------------------------
+The serving hot path has failure modes training steps don't, so four
+more rules ride the same compile:
+
+* **DL206** — donation audit.  With ``donation=True`` the analyzer
+  diffs the *declared* donations (``lowered.args_info``) against the
+  ``input_output_alias`` table XLA actually committed to: a donated
+  buffer the compiled program does NOT alias silently doubles its
+  footprint (the K/V pools are the motivating case), and a large
+  (>= :data:`DONATION_BYTES_THRESHOLD`) undonated input whose
+  shape/dtype matches an unconsumed output is a donation the author
+  forgot.  This is the compiled-program counterpart of the jaxpr-level
+  DL005.
+* **DL207** — recompile audit.  Every report carries the input
+  ``signature`` (dtype + weak-type flag + shape per leaf) and the
+  measured ``compile_s``; :func:`audit_compiles` counts distinct
+  lowerings per family (the prefill bucket set), estimates the warmup
+  tail, and flags two units in one bracketed group (``prefill[8]`` /
+  ``prefill[16]``) that lower the *same shapes* under different
+  dtype/weak-type signatures — the accidental-retrace class.  The
+  distinct-compile *count* is budget-gated in the family lockfile
+  (:mod:`distlearn_tpu.lint.budget`), so a new bucket fails tier-1
+  until consciously re-baselined.
+* **DL208** — entry relayout.  :func:`count_entry_relayouts` counts
+  ``copy``/``transpose`` instructions in the ENTRY computation whose
+  operand is an entry parameter — the compiler disagreeing with the
+  caller about layout and paying a materialized relayout on every
+  dispatch.  The count is budget-gated per unit (exact, like DL205).
+* **DL209** — non-jitted tick-loop work.  :func:`lint_tick_loop` is a
+  pure AST pass over ``serve/engine.py`` and ``serve/scheduler.py``
+  flagging numpy/jnp *tensor math* (not bookkeeping) in the per-tick
+  host methods (:data:`TICK_HOT_METHODS`) — math there runs once per
+  tick on the host and belongs inside the jitted tick program.
 """
 
 from __future__ import annotations
 
+import ast
 import math
 import re
+import time
+from collections import Counter
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -47,9 +85,11 @@ import numpy as np
 from distlearn_tpu.lint.core import Finding
 from distlearn_tpu.utils import compat
 
-__all__ = ["CollectiveOp", "CostReport", "analyze_step",
-           "parse_collectives", "GATHER_BYTES_THRESHOLD",
-           "REPLICATED_BYTES_THRESHOLD", "COLLECTIVE_KINDS"]
+__all__ = ["CollectiveOp", "CostReport", "analyze_step", "audit_compiles",
+           "count_entry_relayouts", "lint_tick_loop", "parse_collectives",
+           "GATHER_BYTES_THRESHOLD", "REPLICATED_BYTES_THRESHOLD",
+           "DONATION_BYTES_THRESHOLD", "COLLECTIVE_KINDS",
+           "TICK_HOT_METHODS"]
 
 #: HLO opcodes the model attributes traffic to.
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
@@ -61,6 +101,34 @@ GATHER_BYTES_THRESHOLD = 1 << 20
 
 #: DL202 fires only for replicated parameters at least this large.
 REPLICATED_BYTES_THRESHOLD = 1 << 20
+
+#: DL206's *missing*-donation arm only flags undonated inputs at least
+#: this large (64 KiB): the K/V pools it exists for are hundreds of KiB
+#: even on the lint mesh, while scalars/lens/token vectors that happen
+#: to shape-match an output are not worth a donation.  The *wasted* arm
+#: (declared donated, not aliased) fires at any size — a wasted donation
+#: is a correctness smell, not just a memory one.
+DONATION_BYTES_THRESHOLD = 1 << 16
+
+#: Per-tick host methods on the serve hot path that DL209 audits: the
+#: decode/admit/step loop bodies in ``serve/engine.py`` and
+#: ``serve/scheduler.py``.  Nested ``def``s inside them are the staged
+#: (jitted) program bodies and are exempt.
+TICK_HOT_METHODS = frozenset({"tick", "admit", "step", "_tick", "_admit",
+                              "_expire", "_dispatch"})
+
+#: numpy/jnp calls DL209 treats as tensor *math* when issued per tick on
+#: the host.  Bookkeeping (``asarray``, ``flatnonzero``, ``zeros``,
+#: ``arange``, boolean masks) is deliberately absent: marshalling
+#: arguments for the jitted program is the host loop's job.
+_TENSOR_MATH_FNS = frozenset({
+    "exp", "exp2", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "power", "tanh", "sin", "cos", "sinh", "cosh",
+    "matmul", "dot", "vdot", "inner", "outer", "tensordot", "einsum",
+    "argmax", "argmin", "softmax", "logsumexp",
+    "cumsum", "cumprod", "mean", "std", "var", "median",
+    "sort", "argsort", "take_along_axis", "top_k",
+})
 
 # f8 variants intentionally coarse; HLO spells dtypes like f32, bf16, s64.
 _DTYPE_BYTES = {
@@ -205,6 +273,17 @@ class CostReport:
     collectives: list[CollectiveOp] = field(default_factory=list)
     memory: dict | None = None
     flops: float | None = None
+    #: hashable input signature: one (dtype, weak_type, shape) triple per
+    #: flat argument leaf — two units with equal signatures share one
+    #: compile-cache entry, distinct signatures are distinct lowerings
+    #: (the DL207 accounting unit)
+    signature: tuple | None = None
+    #: measured lowering+compile wall time; feeds the warmup-tail
+    #: estimate but stays OUT of the lockfile (nondeterministic)
+    compile_s: float | None = None
+    #: entry-parameter copy/transpose count in the compiled module
+    #: (DL208); None when no HLO was inspected
+    relayout_ops: int | None = None
 
     @property
     def bytes_by_kind(self) -> dict[str, int]:
@@ -246,6 +325,7 @@ class CostReport:
             "peak_bytes": self.peak_bytes,
             "temp_bytes": self.memory.get("temp") if self.memory else None,
             "flops": self.flops,
+            "relayout_ops": self.relayout_ops,
         }
 
 
@@ -365,25 +445,266 @@ def _is_spec(x) -> bool:
     return isinstance(x, (NamedSharding, PartitionSpec))
 
 
+# --------------------------------------------------------------- DL206 --
+
+def _alias_param_ids(hlo_text: str) -> set[int]:
+    """Flat parameter numbers the compiled module's ``input_output_alias``
+    table aliases to an output.  The attribute nests braces
+    (``{ {0}: (23, {}, may-alias), ... }``), so the payload is isolated
+    with a brace scan and the targets read as ``(N, ...)`` tuples."""
+    marker = "input_output_alias={"
+    i = hlo_text.find(marker)
+    if i < 0:
+        return set()
+    j, depth = i + len(marker), 1
+    while j < len(hlo_text) and depth:
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+        j += 1
+    sub = hlo_text[i + len(marker):j - 1]
+    return {int(n) for n in re.findall(r"\((\d+)\s*,", sub)}
+
+
+def _leaf_bytes(leaf) -> int:
+    size = getattr(leaf, "size", None)
+    if size is None:
+        size = math.prod(getattr(leaf, "shape", ()) or (1,))
+    return int(size) * getattr(
+        np.dtype(getattr(leaf, "dtype", "f4")), "itemsize", 4)
+
+
+def _check_donation(lowered, hlo_text: str, name: str) -> list[Finding]:
+    """DL206: declared donations vs. the aliases XLA committed to, plus
+    large undonated inputs a matching output could have consumed."""
+    import jax
+    try:
+        in_leaves = jax.tree_util.tree_leaves(lowered.args_info)
+        out_leaves = jax.tree_util.tree_leaves(lowered.out_info)
+    except Exception:
+        return []            # pre-args_info jax: nothing to audit
+    aliased = _alias_param_ids(hlo_text)
+    findings = []
+    for i, leaf in enumerate(in_leaves):
+        if getattr(leaf, "donated", False) and i not in aliased:
+            findings.append(Finding(
+                "DL206",
+                f"input #{i} ({tuple(leaf.shape)}/{leaf.dtype}, "
+                f"{_leaf_bytes(leaf)} bytes) is declared donated but the "
+                "compiled program aliases it to NO output — the caller's "
+                "buffer is invalidated and no memory is saved; drop the "
+                "donation or give the program a shape/dtype-matching "
+                "output to reuse it",
+                where=name))
+    # outputs still available for aliasing: each committed alias consumes
+    # one output of the donated input's (shape, dtype) — count-aware so
+    # two same-shaped pools can't both claim the same output
+    out_count = Counter((tuple(leaf.shape), str(leaf.dtype))
+                        for leaf in out_leaves)
+    for i in sorted(aliased):
+        if i < len(in_leaves):
+            leaf = in_leaves[i]
+            key = (tuple(leaf.shape), str(leaf.dtype))
+            if out_count.get(key):
+                out_count[key] -= 1
+    for i, leaf in enumerate(in_leaves):
+        if getattr(leaf, "donated", False):
+            continue
+        key = (tuple(leaf.shape), str(leaf.dtype))
+        nbytes = _leaf_bytes(leaf)
+        if nbytes >= DONATION_BYTES_THRESHOLD and out_count.get(key):
+            out_count[key] -= 1
+            findings.append(Finding(
+                "DL206",
+                f"input #{i} ({tuple(leaf.shape)}/{leaf.dtype}, {nbytes} "
+                "bytes) is not donated but a shape/dtype-matching output "
+                "leaf goes unaliased — the program holds both buffers "
+                "live every dispatch; donate the input (engine pools: "
+                "DecodeEngine(donate=True)) to halve its footprint",
+                where=name))
+    return findings
+
+
+# --------------------------------------------------------------- DL207 --
+
+def _arg_signature(args) -> tuple:
+    """Per-leaf (dtype, weak_type, shape) triples — the compile-cache
+    key distinct lowerings are counted by (DL207)."""
+    import jax
+    return tuple(
+        (str(getattr(leaf, "dtype", "?")),
+         bool(getattr(leaf, "weak_type", False)),
+         str(tuple(getattr(leaf, "shape", ()))))
+        for leaf in jax.tree_util.tree_leaves(args))
+
+
+def audit_compiles(family: str, reports) -> tuple[list[Finding], dict]:
+    """DL207 drift audit + the family's compile summary.
+
+    Returns ``(findings, summary)``: findings flag two units of one
+    bracketed group (``decode_prefill[8]``/``[16]``) whose signatures
+    share every shape but differ in dtype or weak-type — the same
+    logical program paying two warmup compiles because a host-side cast
+    or Python-scalar leak drifted the signature.  ``summary`` is
+    ``{"count": distinct lowerings, "warmup_s_estimate": measured
+    compile seconds}`` — the count is what the budget lockfile gates.
+    """
+    findings: list[Finding] = []
+    sigs = {name: rep.signature for name, rep in sorted(reports.items())
+            if rep.signature is not None}
+    groups: dict[str, list] = {}
+    for name, sig in sigs.items():
+        groups.setdefault(name.split("[", 1)[0], []).append((name, sig))
+    for base, members in sorted(groups.items()):
+        by_shapes: dict[tuple, tuple] = {}
+        for name, sig in members:
+            shapes = tuple(s for _dt, _wk, s in sig)
+            prev = by_shapes.setdefault(shapes, (name, sig))
+            if prev[1] != sig:
+                findings.append(Finding(
+                    "DL207",
+                    f"units {prev[0]!r} and {name!r} lower identical "
+                    "shapes under different dtype/weak-type signatures — "
+                    "one logical program costs two warmup compiles "
+                    "(a dtype cast or weak-typed Python scalar drifted "
+                    "the compile-cache key)",
+                    where=f"{family}:{base}"))
+    count = len(set(sigs.values()))
+    warmup = sum(rep.compile_s or 0.0 for rep in reports.values())
+    return findings, {"count": count,
+                      "warmup_s_estimate": round(warmup, 3)}
+
+
+# --------------------------------------------------------------- DL208 --
+
+_PARAM_DEF_RE = re.compile(r"%([\w.\-]+)\s*=\s*\S+\s+parameter\(")
+_RELAYOUT_RE = re.compile(
+    r"=\s*\S+\s+(?:copy|transpose)\("
+    r"(?:[a-z0-9_]+\[[0-9,]*\](?:\{[^}]*\})?\s+)?%([\w.\-]+)")
+
+
+def count_entry_relayouts(hlo_text: str) -> int:
+    """``copy``/``transpose`` ops in the ENTRY computation whose operand
+    is an entry parameter — the compiler re-materializing an argument in
+    a different layout on every dispatch (DL208).  Only the ENTRY block
+    is scanned: fusion-region ``parameter()`` lines are computation-local
+    and say nothing about the program's entry layout contract."""
+    m = re.search(r"^ENTRY\b", hlo_text, re.M)
+    if not m:
+        return 0
+    depth, started, lines = 0, False, []
+    for line in hlo_text[m.start():].splitlines():
+        lines.append(line)
+        depth += line.count("{") - line.count("}")
+        if "{" in line:
+            started = True
+        if started and depth <= 0:
+            break
+    block = "\n".join(lines)
+    params = set(_PARAM_DEF_RE.findall(block))
+    return sum(1 for operand in _RELAYOUT_RE.findall(block)
+               if operand in params)
+
+
+# --------------------------------------------------------------- DL209 --
+
+def _scan_hot_method(node, modname: str, clsname: str) -> list[Finding]:
+    findings = []
+
+    def walk(n):
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue     # staged closure: runs inside the XLA program
+            where = (f"{modname}.{clsname}.{node.name}:"
+                     f"{getattr(child, 'lineno', node.lineno)}")
+            if isinstance(child, ast.BinOp) and isinstance(child.op,
+                                                           ast.MatMult):
+                findings.append(Finding(
+                    "DL209",
+                    f"host-side matrix multiply (@) in per-tick method "
+                    f"{clsname}.{node.name}() runs on every tick — it "
+                    "belongs inside the jitted tick program",
+                    where=where))
+            elif (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and isinstance(child.func.value, ast.Name)
+                    and child.func.value.id in ("np", "jnp", "numpy")
+                    and child.func.attr in _TENSOR_MATH_FNS):
+                findings.append(Finding(
+                    "DL209",
+                    f"per-tick host tensor math "
+                    f"{child.func.value.id}.{child.func.attr}(...) in "
+                    f"{clsname}.{node.name}() — every call is a Python-"
+                    "level pass over tensor data in the serve hot loop; "
+                    "move it inside the jitted tick program",
+                    where=where))
+            walk(child)
+
+    walk(node)
+    return findings
+
+
+def lint_tick_loop(sources=None) -> list[Finding]:
+    """DL209: numpy/jnp tensor math in the per-tick host methods.
+
+    ``sources`` is a list of ``(source, modname)`` pairs (or raw source
+    strings); defaults to ``serve/engine.py`` + ``serve/scheduler.py``.
+    Only methods named in :data:`TICK_HOT_METHODS` directly on a class
+    body are scanned — nested ``def``s are the staged program bodies the
+    math is SUPPOSED to live in, and are skipped both as scan roots and
+    inside a hot method."""
+    if sources is None:
+        import inspect
+        from distlearn_tpu.serve import engine, scheduler
+        sources = [(inspect.getsource(engine), engine.__name__),
+                   (inspect.getsource(scheduler), scheduler.__name__)]
+    findings: list[Finding] = []
+    for item in sources:
+        src, modname = item if isinstance(item, tuple) else (item,
+                                                             "<string>")
+        for cls in ast.walk(ast.parse(src)):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and stmt.name in TICK_HOT_METHODS:
+                    findings += _scan_hot_method(stmt, modname, cls.name)
+    return findings
+
+
 def analyze_step(fn, args: Sequence, *, mesh=None, name: str = "step",
                  in_specs=None,
-                 gather_threshold: int = GATHER_BYTES_THRESHOLD
+                 gather_threshold: int = GATHER_BYTES_THRESHOLD,
+                 donation: bool = False
                  ) -> tuple[CostReport, list[Finding]]:
     """Compile ``fn(*args)`` and build its :class:`CostReport`.
 
     Returns ``(report, findings)`` where findings are the compile-level
-    rules (DL201 implicit all-gather, DL202 replicated parameter); the
-    lockfile rules DL203-DL205 are applied by
+    rules (DL201 implicit all-gather, DL202 replicated parameter, and —
+    with ``donation=True`` — DL206 wasted/missing donation); the
+    lockfile rules DL203-DL205/DL207/DL208 are applied by
     :func:`distlearn_tpu.lint.budget.check_family` over a whole family's
     reports.  ``in_specs`` (optional pytree of
     PartitionSpec/NamedSharding leaves matching ``args``) enables DL202.
+    The report also carries the unit's compile-cache ``signature``,
+    measured ``compile_s``, and entry ``relayout_ops`` for the DL207/
+    DL208 budget gates.
     """
+    t0 = time.perf_counter()
     lowered, compiled = compat.lower_compiled(fn, args)
+    compile_s = time.perf_counter() - t0
+    hlo = compiled.as_text()
     report = CostReport(
         name=name,
-        collectives=parse_collectives(compiled.as_text(), mesh),
+        collectives=parse_collectives(hlo, mesh),
         memory=compat.compiled_memory_stats(compiled),
         flops=compat.compiled_cost_analysis(compiled).get("flops"),
+        signature=_arg_signature(args),
+        compile_s=compile_s,
+        relayout_ops=count_entry_relayouts(hlo),
     )
     findings = []
     large = [op for op in report.collectives
@@ -403,4 +724,6 @@ def analyze_step(fn, args: Sequence, *, mesh=None, name: str = "step",
     if in_specs is not None:
         findings += _check_replicated_params(lowered, compiled, args,
                                              in_specs, name)
+    if donation:
+        findings += _check_donation(lowered, hlo, name)
     return report, findings

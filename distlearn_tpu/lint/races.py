@@ -49,8 +49,8 @@ from typing import Iterable
 
 from distlearn_tpu.lint.core import Finding
 
-__all__ = ["lint_races", "analyze_source", "THREAD_API", "SETUP_METHODS",
-           "BENIGN_FIELDS"]
+__all__ = ["lint_races", "analyze_source", "core_targets", "fleet_targets",
+           "THREAD_API", "SETUP_METHODS", "BENIGN_FIELDS"]
 
 
 #: Documented cross-thread public surface per class: methods callable
@@ -78,6 +78,22 @@ THREAD_API: dict = {
                "sample"},
     "Registry": {"counter", "gauge", "histogram", "snapshot",
                  "render_prometheus", "reset"},
+    # -- fleet-era scope (PRs 13-15) --------------------------------------
+    # router: generate() runs on every caller thread; health probes run
+    # on the refresher cadence; membership mutators run on the
+    # autoscaler's control thread
+    "Router": {"generate", "health", "add_replica", "remove_replica",
+               "replica_names", "close"},
+    # collector: poll() runs on the autoscaler loop; endpoint membership
+    # is mutated by operator/actuator threads
+    "Collector": {"poll", "add_endpoint", "remove_endpoint"},
+    "FleetRegistry": {"ingest", "forget", "sources", "merged", "total",
+                      "histogram", "breakdown"},
+    # fault plan: the chaos script mutates link state while wrapped
+    # connections consult it from every transport thread
+    "FaultPlan": {"partition", "heal", "delay", "bandwidth", "cut_after",
+                  "fail_dials", "flaky_dials", "connect", "wrap",
+                  "dropped_bytes", "decisions"},
 }
 
 #: Initialization phase per class: writes here happen before the
@@ -154,6 +170,11 @@ BENIGN_FIELDS: dict = {
     ("Registry", "_families"):
         "double-checked locking: lock-free fast-path dict read, create + "
         "re-check under the module _lock (_get())",
+    # -- serve/router.py ---------------------------------------------------
+    ("Router", "_replicas"):
+        "copy-on-write list: membership mutators rebuild and swap the "
+        "whole list under _lock, so generate()'s lock-free availability "
+        "scan only ever sees a complete list (router.py add_replica)",
 }
 
 _MUTATORS = frozenset({
@@ -415,21 +436,46 @@ def analyze_source(src: str, modname: str = "<string>") -> list[Finding]:
     return findings
 
 
+def core_targets() -> list:
+    """The original audit scope: the training/HA/serve-core threaded
+    modules (plus the obs metric primitives they instrument)."""
+    from distlearn_tpu import obs  # noqa: F401  (import side-effects)
+    from distlearn_tpu.obs import core as obs_core
+    from distlearn_tpu.obs import export as obs_export
+    from distlearn_tpu.obs import trace as obs_trace
+    from distlearn_tpu.parallel import async_ea, ha
+    from distlearn_tpu.serve import scheduler, server
+    return [async_ea, ha, server, scheduler,
+            obs_core, obs_export, obs_trace]
+
+
+def fleet_targets() -> list:
+    """The fleet-era scope (PRs 13-15): the serve router, the obs fleet
+    collector, the fault plan, and the autoscaler.  ``tools/`` is not a
+    package, so the autoscaler rides along as a ``(source, modname)``
+    pair read straight off disk."""
+    import os
+    from distlearn_tpu.comm import faults
+    from distlearn_tpu.obs import agg as obs_agg
+    from distlearn_tpu.serve import router
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    with open(os.path.join(repo, "tools", "autoscaler.py")) as fh:
+        autoscaler_src = fh.read()
+    return [router, obs_agg, faults, (autoscaler_src, "tools.autoscaler")]
+
+
 def lint_races(targets: Iterable | None = None) -> list[Finding]:
-    """DL111/DL112 audit.  ``targets``: modules or raw source strings;
-    defaults to the repo's threaded modules (async_ea, ha, serve, obs)."""
+    """DL111/DL112 audit.  ``targets``: modules, raw source strings, or
+    ``(source, modname)`` pairs; defaults to :func:`core_targets` +
+    :func:`fleet_targets` (the full threaded surface)."""
     if targets is None:
-        from distlearn_tpu import obs  # noqa: F401  (import side-effects)
-        from distlearn_tpu.obs import core as obs_core
-        from distlearn_tpu.obs import export as obs_export
-        from distlearn_tpu.obs import trace as obs_trace
-        from distlearn_tpu.parallel import async_ea, ha
-        from distlearn_tpu.serve import scheduler, server
-        targets = [async_ea, ha, server, scheduler,
-                   obs_core, obs_export, obs_trace]
+        targets = core_targets() + fleet_targets()
     findings: list[Finding] = []
     for t in targets:
-        if isinstance(t, str):
+        if isinstance(t, tuple):
+            src, modname = t
+        elif isinstance(t, str):
             src, modname = t, "<string>"
         else:
             src, modname = inspect.getsource(t), t.__name__

@@ -41,6 +41,7 @@ class Unit:
     args: tuple = ()
     mesh: Any = None
     in_specs: Any = None     # pytree of PartitionSpecs matching args (DL202)
+    donation: bool = False   # run the DL206 donation audit on this unit
     info: dict = field(default_factory=dict)  # analysis metadata
     # (state counts, ...) surfaced on the LintResult / in --format json
 
@@ -277,12 +278,19 @@ def _seq_family():
 
 def _decode_family():
     """Serving decode programs (distlearn_tpu.serve): the tp-sharded
-    continuous-batching tick and the bucketed prefill.  The cost
+    continuous-batching tick and EVERY bucketed prefill.  The cost
     lockfile pins the two psums per block — a serving regression that
-    adds collectives to the per-token path shows up here, not at p99."""
+    adds collectives to the per-token path shows up here, not at p99 —
+    plus the serve-path DL206-DL209 surface: the engine runs with
+    donation on (its production configuration), every unit goes through
+    the donation audit, the full bucket set pins the family's
+    distinct-compile count (DL207), each unit's entry relayout count is
+    budgeted (DL208), and the tick-loop AST pass (DL209) rides along as
+    a findings-only unit."""
     import numpy as np
     import jax
     from jax.sharding import Mesh
+    from distlearn_tpu.lint.cost import lint_tick_loop
     from distlearn_tpu.models.transformer import transformer_lm
     from distlearn_tpu.serve.engine import DecodeEngine
     tp = 2
@@ -290,13 +298,15 @@ def _decode_family():
     model = transformer_lm(vocab=64, dim=32, depth=2, heads=4, max_len=64)
     params, _ = model.init(jax.random.PRNGKey(0))
     eng = DecodeEngine(params, num_slots=4, page=8, mesh=mesh,
-                       tp_axis="model", donate=False)
-    units = [
-        ("decode_tick", eng.tick_program, eng.tick_args()),
-        ("decode_prefill", eng.prefill_program,
-         eng.prefill_args(eng.buckets[0])),
-    ]
-    return _lint_units(units, mesh)
+                       tp_axis="model", donate=True)
+    units = [("decode_tick", eng.tick_program, eng.tick_args())]
+    units += [(f"decode_prefill[{b}]", eng.prefill_program,
+               eng.prefill_args(b)) for b in eng.buckets]
+    out = _lint_units(units, mesh)
+    for u in out:
+        u.donation = True
+    out.append(Unit("tick_loop", lint_tick_loop()))
+    return out
 
 
 def _wirek_family():
@@ -349,19 +359,25 @@ def _model_family():
     exhaustively explored, with its state/transition counts carried as
     unit info, and every ``async_ea_*`` schedule is diffed against the
     wire constants/call sites in ``async_ea.py``."""
-    from distlearn_tpu.lint.conformance import lint_conformance
+    from distlearn_tpu.lint.conformance import (lint_conformance,
+                                                lint_serve_frames)
     from distlearn_tpu.lint.model import lint_models
     units = [Unit(spec.name, rep.findings, info=rep.info)
              for rep, spec in lint_models()]
     units.append(Unit("conformance", lint_conformance()))
+    units.append(Unit("serve_frames", lint_serve_frames()))
     return units
 
 
 def _races_family():
-    """Static lockset race detection (DL111/DL112) over the threaded
-    modules (async_ea, ha, serve, obs)."""
-    from distlearn_tpu.lint.races import lint_races
-    return [Unit("lockset", lint_races())]
+    """Static lockset race detection (DL111/DL112), split into the core
+    scope (async_ea, ha, serve server/scheduler, obs core) and the
+    fleet-era ``router`` scope (serve router, obs Collector, fault
+    plan, autoscaler)."""
+    from distlearn_tpu.lint.races import (core_targets, fleet_targets,
+                                          lint_races)
+    return [Unit("lockset", lint_races(core_targets())),
+            Unit("router", lint_races(fleet_targets()))]
 
 
 _FAMILIES = {
@@ -434,7 +450,7 @@ def run_family_costed(name: str, *, suppress: Sequence[str] = (),
             from distlearn_tpu.lint import cost as cost_mod
             report, cost_findings = cost_mod.analyze_step(
                 u.fn, u.args, mesh=u.mesh, name=f"{name}:{u.name}",
-                in_specs=u.in_specs)
+                in_specs=u.in_specs, donation=u.donation)
             reports[u.name] = report
             findings += cost_findings
         results.append(LintResult(f"{name}:{u.name}",
@@ -447,6 +463,12 @@ def run_family_costed(name: str, *, suppress: Sequence[str] = (),
             suppress)
         if bfindings:
             results.append(LintResult(f"{name}:budget", bfindings))
+        if reports:
+            from distlearn_tpu.lint import cost as cost_mod
+            cfindings, summary = cost_mod.audit_compiles(name, reports)
+            results.append(LintResult(
+                f"{name}:compiles",
+                filter_suppressed(cfindings, suppress), info=summary))
     return results, reports
 
 

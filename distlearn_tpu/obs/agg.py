@@ -242,12 +242,17 @@ def read_trail_snapshot(path: str) -> dict | None:
 
 class Collector:
     """Pull-based fleet ingestion: HTTP ``/snapshot`` endpoints and/or
-    JSONL trails into one :class:`FleetRegistry`."""
+    JSONL trails into one :class:`FleetRegistry`.
+
+    Endpoint membership is mutated by the actuator/operator thread while
+    :meth:`poll` runs on the autoscaler loop, so the source lists are
+    guarded by ``_lock``; poll iterates a snapshot taken under it."""
 
     def __init__(self, endpoints=(), trails=(), *, timeout: float = 2.0,
                  fleet: FleetRegistry | None = None):
         """``endpoints``: ``(host, port)`` pairs or full URLs;
         ``trails``: JSONL paths (source = file basename)."""
+        self._lock = threading.Lock()
         self.endpoints = [e if isinstance(e, str)
                           else f"http://{e[0]}:{int(e[1])}"
                           for e in endpoints]
@@ -263,20 +268,27 @@ class Collector:
 
     def add_endpoint(self, host: str, port: int):
         url = f"http://{host}:{int(port)}"
-        if url not in self.endpoints:
-            self.endpoints.append(url)
+        with self._lock:
+            if url not in self.endpoints:
+                self.endpoints.append(url)
 
     def remove_endpoint(self, host: str, port: int):
         url = f"http://{host}:{int(port)}"
-        if url in self.endpoints:
+        with self._lock:
+            if url not in self.endpoints:
+                return
             self.endpoints.remove(url)
-            self.fleet.forget(url)
+        # FleetRegistry has its own lock; don't nest it under ours
+        self.fleet.forget(url)
 
     def poll(self) -> FleetRegistry:
         """One ingest round over every endpoint and trail.  Failures
         skip the source (its previous contribution stands) and count —
         the fleet view degrades gracefully while a member restarts."""
-        for url in list(self.endpoints):
+        with self._lock:
+            endpoints = list(self.endpoints)
+            trails = list(self.trails)
+        for url in endpoints:
             try:
                 with urllib.request.urlopen(url + "/snapshot",
                                             timeout=self.timeout) as resp:
@@ -284,7 +296,7 @@ class Collector:
                 self.fleet.ingest(rec, source=url)
             except (OSError, ValueError):
                 self._c_fail.labels(source=url).inc()
-        for path in list(self.trails):
+        for path in trails:
             rec = read_trail_snapshot(path)
             if rec is None:
                 self._c_fail.labels(source=os.path.basename(path)).inc()

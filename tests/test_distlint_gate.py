@@ -82,3 +82,24 @@ def test_cli_runs_protocol_family_in_process(devices):
     assert cli.main(["--family", "nope"]) == 2
     assert cli.main([]) == 2
     assert cli.main(["--family", "protocol", "--disable", "DL999"]) == 2
+
+
+def test_cli_json_schema_covers_serve_rules(devices, capsys):
+    """The JSON document advertises the serve-path rules and the per-family
+    compile summary — the machine surface downstream dashboards key on."""
+    import importlib.util
+    import json
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "distlint_cli_json", os.path.join(os.path.dirname(__file__), "..",
+                                          "tools", "distlint.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    assert cli.main(["--family", "decode", "--family", "races",
+                     "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    for rule in ("DL206", "DL207", "DL208", "DL209"):
+        assert rule in doc["rules"]
+    assert doc["compiles"]["decode"]["count"] == 5, doc["compiles"]
+    assert doc["compiles"]["decode"]["warmup_s_estimate"] > 0
+    assert doc["errors"] == 0

@@ -4,10 +4,15 @@ and a known-good step that stays quiet, on the 8-device CPU mesh.
 The firing fixtures are the real failure modes the rules exist for: a
 mis-sharded matmul whose operand GSPMD must rematerialize with a
 replication all-gather (DL201), a sharded in-spec that compiles to a
-replicated parameter (DL202), and stale budget lockfiles (DL203-DL205).
+replicated parameter (DL202), stale budget lockfiles (DL203-DL205), and
+the serve-path rules — a donation the compiled program can't use / a
+pool left undonated (DL206), an unbudgeted extra lowering or a
+dtype-drift retrace (DL207), an entry-parameter relayout over budget
+(DL208), and host-side tensor math in the per-tick loop (DL209).
 """
 
 import copy
+import warnings
 
 import jax
 import numpy as np
@@ -254,3 +259,203 @@ def test_parse_collectives_async_start_counts_once():
     assert len(ops) == 1
     assert ops[0].kind == "all-gather"
     assert ops[0].axes == ("data",)
+
+
+# ---------------------------------------------------------------- DL206 --
+
+BIG_POOL = (256, 256)         # f32: 256 KiB, over DONATION_BYTES_THRESHOLD
+
+
+def test_dl206_fires_on_wasted_donation(devices):
+    """Donating a buffer the program's outputs can't absorb (no
+    shape/dtype match) invalidates the caller's copy for nothing."""
+    fn = jax.jit(lambda y: jax.numpy.zeros((64,), "float32"),
+                 donate_argnums=(0,))
+    args = (jax.ShapeDtypeStruct(BIG_POOL, "float32"),)
+    with warnings.catch_warnings():
+        # jax itself warns 'Some donated buffers were not usable' — that
+        # warning is exactly the condition DL206 turns into a gate
+        warnings.simplefilter("ignore")
+        _, findings = cost_mod.analyze_step(fn, args, name="wasted",
+                                            donation=True)
+    dl = [f for f in findings if f.rule == "DL206"]
+    assert len(dl) == 1, findings
+    assert "declared donated" in dl[0].message
+
+
+def test_dl206_fires_on_missing_donation(devices):
+    """A 256 KiB in-place update without donation holds input AND output
+    buffers live — the KV-pool footprint doubler."""
+    fn = jax.jit(lambda s: s + 1.0)
+    args = (jax.ShapeDtypeStruct(BIG_POOL, "float32"),)
+    _, findings = cost_mod.analyze_step(fn, args, name="undonated",
+                                        donation=True)
+    dl = [f for f in findings if f.rule == "DL206"]
+    assert len(dl) == 1, findings
+    assert "not donated" in dl[0].message
+
+
+def test_dl206_quiet_when_donation_aliases(devices):
+    fn = jax.jit(lambda s: s + 1.0, donate_argnums=(0,))
+    args = (jax.ShapeDtypeStruct(BIG_POOL, "float32"),)
+    _, findings = cost_mod.analyze_step(fn, args, name="donated",
+                                        donation=True)
+    assert not [f for f in findings if f.rule == "DL206"], findings
+
+
+def test_dl206_quiet_below_threshold(devices):
+    """Small bookkeeping buffers (lens, cursors) shape-matching an output
+    are not worth a donation — the missing arm has a size floor."""
+    fn = jax.jit(lambda s: s + 1)
+    args = (jax.ShapeDtypeStruct((4,), "int32"),)
+    _, findings = cost_mod.analyze_step(fn, args, name="lens",
+                                        donation=True)
+    assert not [f for f in findings if f.rule == "DL206"], findings
+
+
+def test_dl206_needs_opt_in(devices):
+    """Training-family callers never asked for the donation audit —
+    the default analyze_step stays DL206-silent."""
+    fn = jax.jit(lambda s: s + 1.0)
+    args = (jax.ShapeDtypeStruct(BIG_POOL, "float32"),)
+    _, findings = cost_mod.analyze_step(fn, args, name="train_step")
+    assert not [f for f in findings if f.rule == "DL206"], findings
+
+
+# ---------------------------------------------------------------- DL207 --
+
+def _rep(name, sig):
+    return cost_mod.CostReport(name=name, signature=sig, compile_s=0.25)
+
+
+def test_audit_compiles_counts_distinct_lowerings():
+    reports = {
+        "prefill[8]": _rep("prefill[8]", (("float32", False, "(8,)"),)),
+        "prefill[16]": _rep("prefill[16]", (("float32", False, "(16,)"),)),
+        "tick": _rep("tick", (("float32", False, "(4,)"),)),
+    }
+    findings, summary = cost_mod.audit_compiles("decode", reports)
+    assert findings == []
+    assert summary["count"] == 3
+    assert summary["warmup_s_estimate"] == pytest.approx(0.75)
+
+
+def test_dl207_fires_on_signature_drift():
+    """Two buckets lowering the same shapes under different dtypes is one
+    logical program paying two compiles."""
+    reports = {
+        "prefill[8]": _rep("prefill[8]", (("float32", False, "(8,)"),)),
+        "prefill[8]x": _rep("prefill[8]x", (("bfloat16", False, "(8,)"),)),
+    }
+    findings, summary = cost_mod.audit_compiles("decode", reports)
+    assert [f.rule for f in findings] == ["DL207"]
+    assert "dtype/weak-type" in findings[0].message
+    assert summary["count"] == 2
+
+
+def test_dl207_fires_on_unbudgeted_compile_count(step_report):
+    """An extra lowering beyond the committed compile count fails the
+    gate — the new-prefill-bucket acceptance case."""
+    budget = {"units": {"psum_step": step_report.to_json()},
+              "compiles": {"count": 0}}
+    findings = budget_mod.check_family("fx", {"psum_step": step_report},
+                                       budget=budget)
+    assert [f.rule for f in findings] == ["DL207"]
+    assert "distinct programs" in findings[0].message
+
+
+def test_dl207_quiet_at_committed_count_and_without_key(step_report):
+    budget = {"units": {"psum_step": step_report.to_json()},
+              "compiles": {"count": 1}}
+    assert budget_mod.check_family("fx", {"psum_step": step_report},
+                                   budget=budget) == []
+    # pre-DL207 lockfiles have no 'compiles' key: the gate must skip,
+    # not fire, so old trees keep linting while they re-baseline
+    legacy = {"units": {"psum_step": step_report.to_json()}}
+    assert budget_mod.check_family("fx", {"psum_step": step_report},
+                                   budget=legacy) == []
+
+
+def test_save_budget_commits_compile_count(step_report, tmp_path):
+    budget_mod.save_budget("fx", {"psum_step": step_report},
+                           budget_dir=str(tmp_path))
+    committed = budget_mod.load_budget("fx", budget_dir=str(tmp_path))
+    assert committed["compiles"] == {"count": 1}
+
+
+# ---------------------------------------------------------------- DL208 --
+
+_RELAYOUT_HLO = """
+%fused_computation {
+  %param_0 = f32[8,4]{1,0} parameter(0)
+  %t.1 = f32[4,8]{1,0} transpose(f32[8,4]{1,0} %param_0), dimensions={1,0}
+  ROOT %r = f32[4,8]{1,0} negate(f32[4,8]{1,0} %t.1)
+}
+
+ENTRY %main.1 (p0: f32[8,4], p1: f32[16]) -> f32[4,8] {
+  %p0 = f32[8,4]{1,0} parameter(0)
+  %p1 = f32[16]{0} parameter(1)
+  %copy.2 = f32[8,4]{0,1} copy(f32[8,4]{1,0} %p0)
+  %other = f32[16]{0} negate(f32[16]{0} %p1)
+  %t.9 = f32[4,8]{1,0} transpose(f32[8,4]{0,1} %copy.2), dimensions={1,0}
+  ROOT %out = f32[4,8]{1,0} fusion(f32[4,8]{1,0} %t.9), kind=kLoop, calls=%fused_computation
+}
+"""
+
+
+def test_count_entry_relayouts_scans_entry_only():
+    """The entry param's copy counts; the fusion region's transpose of
+    its OWN parameter(0) does not — region params say nothing about the
+    entry layout contract."""
+    assert cost_mod.count_entry_relayouts(_RELAYOUT_HLO) == 1
+    assert cost_mod.count_entry_relayouts("no entry here") == 0
+
+
+def test_dl208_fires_over_committed_relayouts(step_report):
+    entry = step_report.to_json()
+    assert entry["relayout_ops"] == step_report.relayout_ops
+    drifted = copy.deepcopy(step_report)
+    drifted.relayout_ops = (step_report.relayout_ops or 0) + 2
+    findings = budget_mod.check_family(
+        "fx", {"psum_step": drifted},
+        budget={"units": {"psum_step": entry}})
+    assert [f.rule for f in findings] == ["DL208"]
+    assert "relayout" in findings[0].message
+
+
+def test_dl208_quiet_at_committed_count(step_report):
+    budget = {"units": {"psum_step": step_report.to_json()}}
+    assert budget_mod.check_family("fx", {"psum_step": step_report},
+                                   budget=budget) == []
+
+
+# ---------------------------------------------------------------- DL209 --
+
+_HOT_LOOP_SRC = '''
+class Scheduler:
+    def tick(self):
+        probs = np.exp(self.logits)          # host softmax: flagged
+        score = self.a @ self.b              # host matmul: flagged
+        idx = np.flatnonzero(self.free)      # bookkeeping: exempt
+        fn = lambda v: np.exp(v)             # not executed per tick
+        def prefill(p, x):                   # staged program body: exempt
+            return jnp.softmax(x @ p)
+        return idx
+
+    def helper(self):
+        return np.exp(self.x)                # not a hot method: exempt
+'''
+
+
+def test_dl209_fires_on_host_tensor_math():
+    findings = cost_mod.lint_tick_loop([(_HOT_LOOP_SRC, "fx.sched")])
+    assert [f.rule for f in findings] == ["DL209", "DL209"]
+    assert "np.exp" in findings[0].message
+    assert "matrix multiply" in findings[1].message
+    assert findings[0].where.startswith("fx.sched.Scheduler.tick:")
+
+
+def test_dl209_quiet_on_real_serve_loop():
+    """The shipped engine/scheduler tick paths are bookkeeping-only —
+    the default-target pass returns nothing."""
+    assert cost_mod.lint_tick_loop() == []

@@ -237,3 +237,45 @@ def test_dl310_unmodeled_message_type_fires():
     fs = lint_conformance(source=src)
     assert _rules(fs) == ["DL310"]
     assert "SNAPSHOT_Q" in fs[0].message
+
+
+# --------------------------------------------- DL310 serve-frame bindings
+
+def test_serve_frames_clean_on_unmutated_tree():
+    from distlearn_tpu.lint.conformance import lint_serve_frames
+    assert lint_serve_frames() == []
+
+
+def test_dl310_ghost_stream_field_fires():
+    """A field the server starts emitting without a binding entry is a
+    protocol change the model never reviewed."""
+    import inspect
+    from distlearn_tpu.lint.conformance import lint_serve_frames
+    from distlearn_tpu.serve import server
+    src = inspect.getsource(server) + (
+        '\n\ndef _ghost(conn, rid):\n'
+        '    conn.send_stream({"rid": rid, "shard_hint": 1})\n')
+    fs = lint_serve_frames(server_source=src)
+    assert _rules(fs) == ["DL310"]
+    assert fs[0].where == "serve_frames.R.shard_hint"
+
+
+def test_dl310_renamed_stream_field_fires_both_ways():
+    """Renaming ``retry_after`` across every producer/consumer leaves the
+    committed binding stale AND introduces an unbound field — the audit
+    reports both directions so the fix is unambiguous."""
+    import inspect
+    from distlearn_tpu.lint.conformance import lint_serve_frames
+    from distlearn_tpu.serve import client, router, server
+
+    def ren(mod):
+        return inspect.getsource(mod).replace('"retry_after"',
+                                              '"retry_after_s"')
+
+    fs = lint_serve_frames(server_source=ren(server),
+                           router_source=ren(router),
+                           client_source=ren(client))
+    wheres = sorted(f.where for f in fs)
+    assert _rules(fs) == ["DL310"]
+    assert wheres == ["serve_frames.R.retry_after",
+                      "serve_frames.R.retry_after_s"]

@@ -9,6 +9,7 @@ import inspect
 import pytest
 
 from distlearn_tpu.lint.races import (BENIGN_FIELDS, analyze_source,
+                                      core_targets, fleet_targets,
                                       lint_races)
 
 pytestmark = pytest.mark.model
@@ -22,6 +23,13 @@ def _rules(findings):
 
 def test_repo_threaded_modules_audit_clean():
     assert lint_races() == []
+
+
+def test_core_and_fleet_scopes_audit_clean():
+    """The two registry units (lockset = PR-1..12 modules, router = the
+    fleet-era modules) each audit clean on their own."""
+    assert lint_races(core_targets()) == []
+    assert lint_races(fleet_targets()) == []
 
 
 def test_benign_list_entries_all_suppress_something():
@@ -71,6 +79,34 @@ def test_dl111_stripping_count_sync_lock_fires():
     hit = [f for f in fs if "_sync_count" in f.where]
     assert hit, [str(f) for f in fs]
     assert "holds no lock" in hit[0].message
+
+
+def test_dl111_stripping_collector_lock_fires():
+    """Same mutation against the fleet-era scope: drop the membership
+    lock from ``Collector.add_endpoint`` in the REAL obs/agg source and
+    the endpoints-list append races poll()'s guarded snapshot."""
+    from distlearn_tpu.obs import agg
+
+    class Strip(ast.NodeTransformer):
+        def visit_FunctionDef(self, node):
+            self.generic_visit(node)
+            if node.name == "add_endpoint":
+                body = []
+                for st in node.body:
+                    if isinstance(st, ast.With):
+                        body.extend(st.body)
+                    else:
+                        body.append(st)
+                node.body = body
+            return node
+
+    src = inspect.getsource(agg)
+    mutated = ast.unparse(Strip().visit(ast.parse(src)))
+    assert mutated != src
+    fs = analyze_source(mutated, "mutated")
+    hit = [f for f in fs
+           if f.rule == "DL111" and "Collector.endpoints" in f.where]
+    assert hit, [str(f) for f in fs]
 
 
 # ----------------------------------------------------- verdict semantics

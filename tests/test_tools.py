@@ -154,13 +154,16 @@ def test_distlint_model_and_races_flags(capsys):
     out = capsys.readouterr().out
     assert "model:sync: OK (" in out and "states)" in out
     assert "races:lockset: OK" in out
+    assert "races:router: OK" in out
     assert "model:conformance: OK" in out
+    assert "model:serve_frames: OK" in out
 
     assert cli.main(["--model", "--races", "--format", "json"]) == 0
     doc = _json.loads(capsys.readouterr().out)
-    assert set(doc) == {"findings", "costs", "info", "units", "errors"}
+    assert set(doc) == {"findings", "costs", "compiles", "rules", "info",
+                        "units", "errors"}
     assert doc["findings"] == [] and doc["errors"] == 0
-    assert doc["units"] == 9
+    assert doc["units"] == 11
     for unit in ("model:sync", "model:sharded", "model:replay",
                  "model:failover", "model:serve", "model:membership",
                  "model:router"):

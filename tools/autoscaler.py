@@ -125,7 +125,8 @@ class Autoscaler:
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
         self.enabled = bool(enabled) and obs.enabled()
-        self._last_breach = self._last_action = None
+        self._last_breach: float | None = None
+        self._last_action: float | None = None
         self._c_events = obs.counter(
             "autoscaler_scale_events_total",
             "scaling actions taken, by direction", labels=("direction",))

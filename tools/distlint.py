@@ -64,6 +64,8 @@ def _print_cost_table(family: str, reports) -> None:
                  for k, v in sorted(rep.bytes_by_axis.items())]
         peak = rep.peak_bytes
         parts.append(f"peak: {peak}B" if peak is not None else "peak: n/a")
+        if rep.relayout_ops is not None:
+            parts.append(f"entry relayouts: {rep.relayout_ops}")
         print(f"  {family}:{name:24s} " + ("; ".join(parts) or "no traffic"))
 
 
@@ -161,6 +163,10 @@ def main(argv=None) -> int:
                 for r in results for f in r.findings],
             "costs": {fam: _cost_table(reports)
                       for fam, reports in all_reports.items()},
+            "compiles": {r.name.split(":", 1)[0]: r.info
+                         for r in results
+                         if r.name.endswith(":compiles") and r.info},
+            "rules": sorted(RULES),
             "info": {r.name: r.info for r in results if r.info},
             "units": len(results),
             "errors": bad,
@@ -172,8 +178,12 @@ def main(argv=None) -> int:
         if res.findings:
             print(format_findings(res.findings, header=f"{res.name}:"))
         elif not args.quiet:
-            extra = (f" ({res.info['states']:,} states)"
-                     if "states" in res.info else "")
+            extra = ""
+            if "states" in res.info:
+                extra = f" ({res.info['states']:,} states)"
+            elif "count" in res.info:
+                extra = (f" ({res.info['count']} compile(s), "
+                         f"~{res.info['warmup_s_estimate']}s warmup)")
             print(f"{res.name}: OK{extra}")
     if args.costs:
         print("costs (bytes/step per device, post-fusion):")
