@@ -364,6 +364,7 @@ SERVE_FRAME_BINDINGS: dict = {
                        "sheds on it",
         "active": "occupied decode slots; router's least-loaded score",
         "free_pages": "KV pool headroom (capacity telemetry)",
+        "cached_pages": "pool pages retained by the prefix cache",
         "epoch": "serving weights epoch; router's fleet epoch view",
         "ckpt_step": "checkpoint step of the serving weights",
         "swap_pending": "two-phase hot-swap in progress",
@@ -375,6 +376,12 @@ SERVE_FRAME_BINDINGS: dict = {
         "deadline_s": "per-request deadline (optional)",
         "eos": "early-stop token id (optional)",
         "tc": "cross-process trace context (obs.trace.TRACE_KEY)",
+        "temperature": "sampling temperature; 0/absent = exact greedy",
+        "top_k": "top-k logit filter width (0 = off)",
+        "top_p": "nucleus sampling mass (0 = off)",
+        "seed": "per-request sampling key seed (reproducible streams)",
+        "speculate": "False opts a greedy stream out of speculative "
+                     "decoding",
     },
     "R": {
         "rid": "request id echo (stream demux on shared conns)",
@@ -385,6 +392,9 @@ SERVE_FRAME_BINDINGS: dict = {
         "error": "rejection/abort message (error chunks only)",
         "queue_depth": "backlog at rejection time (shed hint)",
         "retry_after": "shed backoff hint in seconds",
+        "accepted": "speculative draft tokens accepted this round",
+        "cached_tokens": "prompt tokens adopted from the prefix cache "
+                         "(first chunk only)",
     },
 }
 

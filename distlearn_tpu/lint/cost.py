@@ -112,10 +112,15 @@ DONATION_BYTES_THRESHOLD = 1 << 16
 
 #: Per-tick host methods on the serve hot path that DL209 audits: the
 #: decode/admit/step loop bodies in ``serve/engine.py`` and
-#: ``serve/scheduler.py``.  Nested ``def``s inside them are the staged
-#: (jitted) program bodies and are exempt.
+#: ``serve/scheduler.py``, the per-round prefill/verify/draft paths
+#: (chunked prefill + speculative decode), and the per-admission radix
+#: walks in ``serve/prefix_cache.py``.  Nested ``def``s inside them are
+#: the staged (jitted) program bodies and are exempt.
 TICK_HOT_METHODS = frozenset({"tick", "admit", "step", "_tick", "_admit",
-                              "_expire", "_dispatch"})
+                              "_expire", "_dispatch", "verify", "begin",
+                              "prefill_step", "_advance_prefills",
+                              "_pump_prefill", "propose", "match",
+                              "insert", "evict_nodes", "evict_for_free"})
 
 #: numpy/jnp calls DL209 treats as tensor *math* when issued per tick on
 #: the host.  Bookkeeping (``asarray``, ``flatnonzero``, ``zeros``,
@@ -650,16 +655,19 @@ def lint_tick_loop(sources=None) -> list[Finding]:
     """DL209: numpy/jnp tensor math in the per-tick host methods.
 
     ``sources`` is a list of ``(source, modname)`` pairs (or raw source
-    strings); defaults to ``serve/engine.py`` + ``serve/scheduler.py``.
-    Only methods named in :data:`TICK_HOT_METHODS` directly on a class
-    body are scanned — nested ``def``s are the staged program bodies the
-    math is SUPPOSED to live in, and are skipped both as scan roots and
-    inside a hot method."""
+    strings); defaults to ``serve/engine.py`` + ``serve/scheduler.py`` +
+    ``serve/prefix_cache.py`` + ``serve/speculate.py`` (every module
+    with per-round host work).  Only methods named in
+    :data:`TICK_HOT_METHODS` directly on a class body are scanned —
+    nested ``def``s are the staged program bodies the math is SUPPOSED
+    to live in, and are skipped both as scan roots and inside a hot
+    method."""
     if sources is None:
         import inspect
-        from distlearn_tpu.serve import engine, scheduler
-        sources = [(inspect.getsource(engine), engine.__name__),
-                   (inspect.getsource(scheduler), scheduler.__name__)]
+        from distlearn_tpu.serve import (engine, prefix_cache, scheduler,
+                                         speculate)
+        sources = [(inspect.getsource(m), m.__name__)
+                   for m in (engine, scheduler, prefix_cache, speculate)]
     findings: list[Finding] = []
     for item in sources:
         src, modname = item if isinstance(item, tuple) else (item,

@@ -157,6 +157,11 @@ BENIGN_FIELDS: dict = {
         "loop-only two-phase swap latch; health() reads only its "
         "None-ness for the swap_pending flag — a tuple attribute "
         "store is GIL-atomic (server.py epoch docstring)",
+    ("ServeServer", "prefix_cache"):
+        "the attribute itself is fixed at __init__ (None or the cache); "
+        "the loop's clear() mutates cache internals and health() reads "
+        "only the pages_held int — a GIL-atomic snapshot, and "
+        "'telemetry tolerates a torn view' like the other gauges",
     # -- obs/core.py --------------------------------------------------------
     ("_Counter", "value"):
         "documented lock-cheap metric path: plain attribute increments "

@@ -65,14 +65,21 @@ class ServeClient:
 
     def generate(self, prompt, max_new: int, *, rid: str | None = None,
                  deadline_s: float | None = None, eos: int | None = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0, speculate: bool = True,
                  timeout: float = 60.0, on_chunk=None,
                  shed_retries: int = 3) -> dict:
         """Run one request to completion.  Returns
-        ``{"rid", "tokens", "reason", "epoch"}``; raises
+        ``{"rid", "tokens", "reason", "epoch", "accepted",
+        "cached_tokens"}``; raises
         :class:`ServeError` on a server-side rejection/abort,
         :class:`ReplicaDead` when the connection dies mid-stream, and
         :class:`TimeoutError` when no chunk lands within ``timeout``.
         ``on_chunk(tokens)`` streams partial output as it arrives.
+        ``temperature``/``top_k``/``top_p``/``seed`` select sampled
+        decoding (``temperature == 0`` is exact greedy);
+        ``speculate=False`` opts a greedy stream out of speculative
+        decoding.
 
         A shed rejection (``retry_after`` in the error chunk) is retried
         on the SAME connection up to ``shed_retries`` times with full
@@ -84,6 +91,17 @@ class ServeClient:
             msg["deadline_s"] = float(deadline_s)
         if eos is not None:
             msg["eos"] = int(eos)
+        # non-default only: the plain greedy frame stays byte-identical
+        if temperature:
+            msg["temperature"] = float(temperature)
+        if top_k:
+            msg["top_k"] = int(top_k)
+        if top_p:
+            msg["top_p"] = float(top_p)
+        if seed:
+            msg["seed"] = int(seed)
+        if not speculate:
+            msg["speculate"] = False
         for attempt in range(max(0, int(shed_retries)) + 1):
             try:
                 return self._stream(msg, rid, timeout, on_chunk)
@@ -104,6 +122,8 @@ class ServeClient:
             raise ReplicaDead(f"replica died on submit: {e!r}") from e
         tokens: list[int] = []
         epoch = None
+        accepted = 0        # speculative drafts the server accepted
+        cached = 0          # prompt tokens served from the prefix cache
         while True:
             try:
                 kind, chunk = self.conn.recv_serve(
@@ -123,6 +143,10 @@ class ServeClient:
                 raise ServeError(chunk["error"],
                                  retry_after=chunk.get("retry_after"),
                                  queue_depth=chunk.get("queue_depth"))
+            if chunk.get("accepted"):
+                accepted += int(chunk["accepted"])
+            if chunk.get("cached_tokens"):
+                cached = int(chunk["cached_tokens"])
             got = chunk.get("tokens") or []
             tokens.extend(int(t) for t in got)
             if got and on_chunk is not None:
@@ -132,7 +156,8 @@ class ServeClient:
                 if reason not in ("complete", "eos"):
                     raise ServeError(f"request ended: {reason}")
                 return {"rid": chunk.get("rid"), "tokens": tokens,
-                        "reason": reason, "epoch": epoch}
+                        "reason": reason, "epoch": epoch,
+                        "accepted": accepted, "cached_tokens": cached}
 
     def close(self):
         self.conn.close()

@@ -25,12 +25,26 @@ the tests pin down (tests/test_serve.py):
   table rows reset to 0 and unallocated tail entries stay 0, so masked
   lanes (inactive slots, padded prefill tails) scatter there instead of
   into live data.
-* **Exact accounting.**  ``free_pages + pages-in-tables == num_pages-1``
-  at all times; double-free and double-admit raise instead of
-  corrupting the pool.
+* **Exact accounting.**  Every allocatable page is either on the free
+  list (refcount 0) or referenced (refcount >= 1):
+  ``free_pages + pages-with-ref > 0 == num_pages - 1`` at all times;
+  double-free and double-admit raise instead of corrupting the pool.
+
+Pages are REFERENCE COUNTED so one physical page can back the same
+logical prefix position of several slots at once (and be retained by
+the radix prefix cache, :mod:`distlearn_tpu.serve.prefix_cache`, after
+every owning request finished).  Sharing is restricted to WHOLE pages
+strictly before a request's first self-written position, which makes
+the copy-on-write discipline structural: a slot only ever writes cache
+positions ``>= cached_len`` (its shared-page count times the page
+size), so a write into a shared page cannot be expressed — there is
+nothing to copy because the writer's pages and the shared pages are
+disjoint rows of its block table by construction.
 """
 
 from __future__ import annotations
+
+from typing import Iterable, Sequence
 
 import numpy as np
 
@@ -66,6 +80,9 @@ class PagedKVCache:
                              "page beyond the reserved trash page 0")
         self.num_pages = int(num_pages)
         self._free: list[int] = list(range(self.num_pages - 1, 0, -1))
+        #: per-page reference count: 0 = free (or the trash page),
+        #: 1 = one owner (a slot row or a prefix-cache node), >1 shared.
+        self.ref = np.zeros((self.num_pages,), np.int32)
         # block_table[s, j] = pool page backing slot s's logical page j
         # (0 = trash: unallocated)
         self.block_table = np.zeros((self.num_slots, self.pages_per_slot),
@@ -87,31 +104,78 @@ class PagedKVCache:
     def free_slots(self) -> int:
         return int((~self.active).sum())
 
-    def can_admit(self, total_len: int) -> bool:
+    def can_admit(self, total_len: int, shared_pages: int = 0) -> bool:
         """True when a request needing ``total_len`` cache positions has
-        both a free slot and enough free pages."""
+        both a free slot and enough free pages; ``shared_pages`` leading
+        pages come from the prefix cache and cost nothing."""
         return (self.free_slots() > 0
-                and self.pages_for(total_len) <= len(self._free)
+                and self.pages_for(total_len) - int(shared_pages)
+                <= len(self._free)
                 and total_len <= self.max_len)
 
+    # -- page reference counting (prefix-cache sharing) ---------------------
+    def share(self, pages: Iterable[int]):
+        """Take one more reference on each (already-allocated) page —
+        a prefix-cache node retaining them, or a slot adopting a cached
+        prefix.  Sharing a free page or the trash page is a bug."""
+        for p in pages:
+            p = int(p)
+            if p <= 0 or p >= self.num_pages:
+                raise ValueError(f"page {p} outside the pool")
+            if self.ref[p] < 1:
+                raise ValueError(f"page {p} is free — cannot share")
+            self.ref[p] += 1
+
+    def unref(self, pages: Iterable[int]) -> int:
+        """Drop one reference per page; pages reaching refcount 0 return
+        to the free list.  Returns how many pages were actually freed."""
+        freed = 0
+        for p in pages:
+            p = int(p)
+            if p <= 0 or p >= self.num_pages or self.ref[p] < 1:
+                raise ValueError(f"page {p} is not allocated (double "
+                                 "unref?)")
+            self.ref[p] -= 1
+            if self.ref[p] == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
+
     # -- slot lifecycle -----------------------------------------------------
-    def admit(self, total_len: int) -> int:
+    def admit(self, total_len: int,
+              shared: Sequence[int] = ()) -> int:
         """Claim a free slot and allocate pages for ``total_len`` cache
-        positions.  Returns the slot index; raises :class:`CacheFull`
-        when capacity is short (callers gate on :meth:`can_admit`)."""
+        positions.  ``shared`` (optional) is a list of already-written
+        pages from the prefix cache installed as the slot's leading
+        block-table rows — each gains a reference instead of an
+        allocation, so a 90%-overlap prompt only allocates its suffix.
+        Returns the slot index; raises :class:`CacheFull` when capacity
+        is short (callers gate on :meth:`can_admit`)."""
         total_len = int(total_len)
         if total_len < 1 or total_len > self.max_len:
             raise ValueError(f"total_len={total_len} outside "
                              f"[1, max_len={self.max_len}]")
         need = self.pages_for(total_len)
-        if need > len(self._free):
-            raise CacheFull(f"{need} pages needed, {len(self._free)} free")
+        shared = [int(p) for p in shared]
+        if len(shared) >= need:
+            raise ValueError(
+                f"{len(shared)} shared pages cover all {need} pages of "
+                f"total_len={total_len}: the request must prefill at "
+                "least its last position itself")
+        if need - len(shared) > len(self._free):
+            raise CacheFull(f"{need - len(shared)} pages needed, "
+                            f"{len(self._free)} free")
         free = np.flatnonzero(~self.active)
         if not len(free):
             raise CacheFull("all slots busy")
+        self.share(shared)      # validates before any state is touched
         slot = int(free[0])
-        for j in range(need):
-            self.block_table[slot, j] = self._free.pop()
+        for j, p in enumerate(shared):
+            self.block_table[slot, j] = p
+        for j in range(len(shared), need):
+            p = self._free.pop()
+            self.block_table[slot, j] = p
+            self.ref[p] = 1
         self.lengths[slot] = 0
         self.last_tok[slot] = 0
         self.limit[slot] = total_len
@@ -119,18 +183,18 @@ class PagedKVCache:
         return slot
 
     def release(self, slot: int):
-        """Finish/evict: return the slot's pages to the pool and reset
-        its block-table row to trash.  Page contents are NOT zeroed —
+        """Finish/evict: drop the slot's page references and reset its
+        block-table row to trash.  Pages still referenced elsewhere (a
+        prefix-cache node, another slot sharing the prefix) survive;
+        the rest return to the pool.  Page contents are NOT zeroed —
         the no-stale-reads invariant (module docstring) makes that
         unnecessary, and skipping it keeps eviction O(pages) host work."""
         slot = int(slot)
         if not self.active[slot]:
             raise ValueError(f"slot {slot} is not active (double release?)")
-        for j in range(self.pages_per_slot):
-            p = int(self.block_table[slot, j])
-            if p:
-                self._free.append(p)
-            self.block_table[slot, j] = 0
+        row = [int(p) for p in self.block_table[slot] if p]
+        self.unref(row)
+        self.block_table[slot] = 0
         self.lengths[slot] = 0
         self.last_tok[slot] = 0
         self.limit[slot] = 0
@@ -138,13 +202,28 @@ class PagedKVCache:
 
     def check(self):
         """Assert the exact-accounting invariant (test hook)."""
-        held = int((self.block_table > 0).sum())
+        held = int((self.ref > 0).sum())
         if held + len(self._free) != self.num_pages - 1:
             raise AssertionError(
-                f"page leak: {held} in tables + {len(self._free)} free "
+                f"page leak: {held} referenced + {len(self._free)} free "
                 f"!= {self.num_pages - 1} allocatable")
         if len(set(self._free)) != len(self._free):
             raise AssertionError("duplicate page in free list")
+        if self.ref[0] != 0:
+            raise AssertionError("the trash page grew a reference")
         live = set(self.block_table[self.block_table > 0].tolist())
         if live & set(self._free):
             raise AssertionError("page both allocated and free")
+        for p in live:
+            if self.ref[p] < 1:
+                raise AssertionError(f"page {p} in a block table with "
+                                     f"refcount {self.ref[p]}")
+        # each slot row must hold at least as many references as it has
+        # pointers to the page (shared prefixes push the count higher)
+        counts = np.bincount(self.block_table.reshape(-1),
+                             minlength=self.num_pages)
+        counts[0] = 0
+        if (counts > self.ref).any():
+            bad = np.flatnonzero(counts > self.ref).tolist()
+            raise AssertionError(f"pages {bad} pointed to by more rows "
+                                 "than their refcount")

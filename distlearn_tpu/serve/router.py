@@ -290,28 +290,35 @@ class Router:
     # -- the request path ---------------------------------------------------
     def generate(self, prompt, max_new: int, *, rid: str | None = None,
                  deadline_s: float | None = None, eos: int | None = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, seed: int = 0, speculate: bool = True,
                  timeout: float = 60.0, on_chunk=None) -> dict:
         """Run one request against the fleet.  Returns ``{"rid",
-        "tokens", "reason", "epoch", "replica"}``; ``reason`` is
-        ``"failed"`` (with an ``"error"`` note and the partial tokens)
-        when the owning replica died mid-stream or fenced.  Raises
+        "tokens", "reason", "epoch", "replica", "accepted",
+        "cached_tokens"}``; ``reason`` is ``"failed"`` (with an
+        ``"error"`` note and the partial tokens) when the owning replica
+        died mid-stream or fenced.  Sampling knobs travel on the 'G'
+        frame (``temperature == 0`` is exact greedy; ``seed`` makes a
+        sampled stream reproducible); ``speculate=False`` opts a greedy
+        stream out of speculative decoding.  Raises
         :class:`RouterBusy` on shed, :class:`ReplicaDead` when every
         replica was tried or attempts ran out, :class:`ServeError` on a
         non-retryable rejection, ``TimeoutError`` past ``timeout``."""
+        kw = dict(rid=rid, deadline_s=deadline_s, eos=eos,
+                  temperature=temperature, top_k=top_k, top_p=top_p,
+                  seed=seed, speculate=speculate, timeout=timeout,
+                  on_chunk=on_chunk)
         if not obs_trace.propagate_enabled():
-            return self._generate(prompt, max_new, rid=rid,
-                                  deadline_s=deadline_s, eos=eos,
-                                  timeout=timeout, on_chunk=on_chunk)
+            return self._generate(prompt, max_new, **kw)
         # one trace per request: this root span is the parent the
         # replica's scheduler/engine spans stitch to (the 'G' frame
         # carries the context) along with the failover/hedge spans here
         with obs_trace.use_context(obs_trace.new_trace()), \
                 obs.span("router.generate", rid=rid or ""):
-            return self._generate(prompt, max_new, rid=rid,
-                                  deadline_s=deadline_s, eos=eos,
-                                  timeout=timeout, on_chunk=on_chunk)
+            return self._generate(prompt, max_new, **kw)
 
     def _generate(self, prompt, max_new: int, *, rid, deadline_s, eos,
+                  temperature, top_k, top_p, seed, speculate,
                   timeout, on_chunk) -> dict:
         start = self._clock()
         overall = start + float(timeout)
@@ -327,6 +334,18 @@ class Router:
             msg["deadline_s"] = float(deadline_s)
         if eos is not None:
             msg["eos"] = int(eos)
+        # sampling fields ride only when non-default, so the plain
+        # greedy 'G' frame stays byte-identical to the pre-sampling wire
+        if temperature:
+            msg["temperature"] = float(temperature)
+        if top_k:
+            msg["top_k"] = int(top_k)
+        if top_p:
+            msg["top_p"] = float(top_p)
+        if seed:
+            msg["seed"] = int(seed)
+        if not speculate:
+            msg["speculate"] = False
         hedge_after = self.hedge_after
         if hedge_after is not None and deadline_s is not None:
             hedge_after = min(hedge_after, 0.5 * float(deadline_s))
@@ -400,7 +419,8 @@ class Router:
             # would duplicate output.  Clean terminal instead of a hang.
             tokens, epoch, err = payload
             return {"rid": rid, "tokens": tokens, "reason": "failed",
-                    "error": err, "epoch": epoch, "replica": rep.name}
+                    "error": err, "epoch": epoch, "replica": rep.name,
+                    "accepted": 0, "cached_tokens": 0}
 
     def _run_stream(self, rep: _Replica, msg: dict, rid: str | None,
                     overall: float, hedge_at: float | None, on_chunk,
@@ -416,6 +436,8 @@ class Router:
         tokens: list[int] = []
         epoch = None
         first_seen = False
+        accepted = 0            # speculative drafts the replica accepted
+        cached = 0              # prompt tokens served from its prefix cache
         try:
             conn.send_gen(msg)
         except OSError as e:
@@ -469,6 +491,10 @@ class Router:
             if chunk.get("error"):
                 conn.close()
                 return "rejected", chunk
+            if chunk.get("accepted"):
+                accepted += int(chunk["accepted"])
+            if chunk.get("cached_tokens"):
+                cached = int(chunk["cached_tokens"])
             got = chunk.get("tokens") or []
             if got:
                 if not first_seen:
@@ -493,7 +519,8 @@ class Router:
                     raise ServeError(f"request ended: {reason}")
                 return "done", {"rid": chunk.get("rid"), "tokens": tokens,
                                 "reason": reason, "epoch": epoch,
-                                "replica": rep.name}
+                                "replica": rep.name, "accepted": accepted,
+                                "cached_tokens": cached}
 
     def close(self):
         with self._lock:

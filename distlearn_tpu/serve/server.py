@@ -51,7 +51,9 @@ from distlearn_tpu.comm import transport
 from distlearn_tpu.comm.transport import ProtocolError
 from distlearn_tpu.obs import trace as obs_trace
 from distlearn_tpu.serve.engine import DecodeEngine
+from distlearn_tpu.serve.prefix_cache import RadixPrefixCache
 from distlearn_tpu.serve.scheduler import QueueFull, Scheduler
+from distlearn_tpu.serve.speculate import NGramDrafter
 from distlearn_tpu.utils.checkpoint import latest_step, restore_checkpoint
 from distlearn_tpu.utils.logging import print_server
 
@@ -120,9 +122,23 @@ class ServeServer:
                  default_max_new: int = 32, frame_timeout: float = 5.0,
                  idle_wait: float = 0.05, drain_timeout: float = 30.0,
                  ckpt_dir: str | None = None, ckpt_poll: float = 0.25,
-                 ckpt_like=None, epoch: int | None = None):
+                 ckpt_like=None, epoch: int | None = None,
+                 prefix_cache: bool = False, spec_k: int | None = None,
+                 prefill_chunk: int | None = None):
+        """Raw-speed knobs (all default OFF — the plain serve path stays
+        byte-identical): ``prefix_cache`` retains finished prompts' K/V
+        pages in a :class:`RadixPrefixCache` so shared-prefix traffic
+        prefills only its suffix; ``spec_k`` enables n-gram speculative
+        decoding with that many draft tokens per verify; ``prefill_chunk``
+        bounds prompt positions prefilled per round while streams decode
+        (chunked prefill — long prompts stop stalling TPOT)."""
         self.engine = engine
-        self.sched = Scheduler(engine, max_queue=max_queue)
+        self.prefix_cache = (RadixPrefixCache(engine.cache)
+                             if prefix_cache else None)
+        self.sched = Scheduler(
+            engine, max_queue=max_queue, prefix_cache=self.prefix_cache,
+            drafter=NGramDrafter(k=spec_k) if spec_k else None,
+            prefill_chunk=prefill_chunk)
         self.default_max_new = int(default_max_new)
         self.frame_timeout = float(frame_timeout)
         self.idle_wait = float(idle_wait)
@@ -187,6 +203,8 @@ class ServeServer:
                 "queue_depth": self.sched.queue_depth(),
                 "active": self.sched.active_count(),
                 "free_pages": self.engine.cache.free_pages(),
+                "cached_pages": (self.prefix_cache.pages_held
+                                 if self.prefix_cache is not None else 0),
                 "epoch": self.epoch,
                 "ckpt_step": self.ckpt_step,
                 "swap_pending": self._swap_pending is not None}
@@ -280,9 +298,19 @@ class ServeServer:
             self.engine.swap_params(tree)
         except ValueError as e:
             # layout drift (wrong depth/shape): refuse the swap, keep
-            # serving the old weights — availability over freshness.
+            # serving the old weights — availability over freshness (and
+            # the prefix cache stays valid: the old params still serve).
             print_server(f"hot swap refused: {e}")
             return
+        if self.prefix_cache is not None:
+            # every cached K/V page was computed under the outgoing
+            # epoch: a new-epoch stream matching one would splice stale
+            # attention state into its prefix.  Invalidate before any
+            # post-swap admission can run.
+            stale = self.prefix_cache.clear()
+            if stale:
+                print_server(f"prefix cache invalidated across epoch "
+                             f"fence ({stale} pages)")
         self.ckpt_step = meta.get("step")
         self.epoch = int(meta.get("epoch", self.ckpt_step or 0))
         self._c_swaps.inc()
@@ -372,7 +400,12 @@ class ServeServer:
                 prompt, int(msg.get("max_new", self.default_max_new)),
                 rid=rid or None,
                 deadline_s=msg.get("deadline_s"),
-                eos=msg.get("eos"))
+                eos=msg.get("eos"),
+                temperature=float(msg.get("temperature", 0.0)),
+                top_k=int(msg.get("top_k", 0)),
+                top_p=float(msg.get("top_p", 0.0)),
+                seed=int(msg.get("seed", 0)),
+                speculate=bool(msg.get("speculate", True)))
         except (QueueFull, ValueError, KeyError, TypeError) as e:
             self._c_reqs.labels(outcome="rejected").inc()
             chunk = {"rid": rid, "error": str(e) or type(e).__name__,
@@ -412,6 +445,15 @@ class ServeServer:
                                             "epoch": self.epoch})
             if ev.kind == "token":
                 chunk["tokens"].append(ev.token)
+                if ev.accepted is not None:
+                    # draft tokens the verify accepted ahead of this one
+                    # (speculative decode observability, summed per chunk)
+                    chunk["accepted"] = (chunk.get("accepted", 0)
+                                         + ev.accepted)
+                if ev.cached is not None:
+                    # prompt tokens adopted from the prefix cache instead
+                    # of prefilled (rides the first chunk only)
+                    chunk["cached_tokens"] = ev.cached
                 self._c_toks.inc()
                 with obs_trace.use_context(self._tc_of.get(ev.rid)):
                     if ev.first:

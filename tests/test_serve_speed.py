@@ -1,0 +1,516 @@
+"""Raw-speed serving tests: radix prefix cache bookkeeping (refcount
+conservation, LRU eviction, epoch clear), speculative decode (exact
+greedy equivalence, implicit rollback), in-tick sampling (seeded
+determinism, temp=0 bitwise parity), and chunked prefill (token parity
+plus the no-TPOT-stall scheduling contract under an injected clock).
+
+The cache/drafter/sampling features are all latency trades on top of
+the serving parity invariant (tests/test_serve.py): every test here
+ultimately compares against ``greedy_generate`` — a cached, chunked,
+or speculated stream must be token-identical to the plain one.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+VOCAB, DIM, DEPTH, HEADS, MAX_LEN = 61, 32, 2, 4, 64
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    import jax
+    from distlearn_tpu.models.transformer import transformer_lm
+    model = transformer_lm(vocab=VOCAB, dim=DIM, depth=DEPTH, heads=HEADS,
+                           max_len=MAX_LEN)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    return params
+
+
+def _greedy_ref(params, prompt, steps):
+    from distlearn_tpu.models.transformer import greedy_generate
+    out = greedy_generate(params, np.asarray(prompt, np.int32)[None], steps)
+    return np.asarray(out)[0].tolist()
+
+
+# -- radix prefix cache: pure bookkeeping (no jax) ----------------------------
+
+def _kv_and_cache(num_slots=3, page=4, max_len=32, max_pages=None):
+    from distlearn_tpu.serve.kv_cache import PagedKVCache
+    from distlearn_tpu.serve.prefix_cache import RadixPrefixCache
+    kv = PagedKVCache(num_slots=num_slots, page=page, max_len=max_len)
+    return kv, RadixPrefixCache(kv, max_pages=max_pages)
+
+
+def _fake_prefill(kv, cache, prompt, max_new=2):
+    """Admit + pretend-prefill ``prompt`` (bookkeeping only: the radix
+    tree never looks at array contents) and retain its whole pages."""
+    cached, pages = cache.match(prompt)
+    slot = kv.admit(len(prompt) + max_new, shared=pages)
+    cache.insert(prompt, kv.block_table[slot])
+    return slot
+
+
+def test_radix_cacheable_len_caps_one_token_short():
+    _, cache = _kv_and_cache(page=4)
+    # the page holding the LAST prompt token must prefill fresh
+    assert cache.cacheable_len(1) == 0
+    assert cache.cacheable_len(4) == 0
+    assert cache.cacheable_len(5) == 4
+    assert cache.cacheable_len(8) == 4
+    assert cache.cacheable_len(9) == 8
+
+
+def test_radix_insert_match_roundtrip_and_refless_lookup():
+    kv, cache = _kv_and_cache(page=4)
+    prompt = np.arange(1, 13, dtype=np.int32)          # 12 toks -> 2 pages
+    slot = kv.admit(16)
+    row = kv.block_table[slot]
+    assert cache.insert(prompt, row) == 2
+    assert cache.pages_held == 2
+    ref_before = kv.ref.copy()
+    got_len, got_pages = cache.match(prompt)
+    assert got_len == 8 and got_pages == [int(row[0]), int(row[1])]
+    # match stamps recency but takes NO references — abandoning the
+    # admission it was quoted for must leak nothing
+    assert (kv.ref == ref_before).all()
+    # divergence inside the second page shortens the match to one page
+    fork = prompt.copy()
+    fork[6] = 55
+    assert cache.match(fork) == (4, [int(row[0])])
+    # shorter than page+1 tokens can never match
+    assert cache.match(prompt[:4]) == (0, [])
+    cache.check()
+    kv.release(slot)
+    cache.check()
+
+
+def test_radix_shared_pages_survive_slot_release():
+    kv, cache = _kv_and_cache(page=4)
+    prompt = np.arange(1, 13, dtype=np.int32)
+    slot = _fake_prefill(kv, cache, prompt)
+    kv.release(slot)                    # cache still holds the 2 pages
+    assert kv.free_pages() == kv.num_pages - 1 - 2
+    # a follow-up admission adopts them by reference
+    cached, pages = cache.match(prompt)
+    assert cached == 8
+    s2 = kv.admit(len(prompt) + 2, shared=pages)
+    assert all(kv.ref[p] == 2 for p in pages)
+    kv.release(s2)
+    assert all(kv.ref[p] == 1 for p in pages)
+    cache.check()
+    assert cache.clear() == 2
+    assert kv.free_pages() == kv.num_pages - 1
+    cache.check()
+
+
+def test_radix_edge_split_and_first_writer_wins():
+    kv, cache = _kv_and_cache(page=4)
+    a = np.array(list(range(1, 9)) + [11, 12, 13, 14], np.int32)   # 12 toks
+    b = np.array(list(range(1, 9)) + [21, 22, 23, 24, 25], np.int32)
+    sa = _fake_prefill(kv, cache, a)
+    sb = _fake_prefill(kv, cache, b)    # shares a's first page, splits
+    assert cache.match(a)[0] == 8 and cache.match(b)[0] == 12
+    assert cache.match(b)[1][0] == cache.match(a)[1][0]     # shared page
+    # re-inserting an already-covered prefix retains nothing new
+    sc = kv.admit(len(a) + 2, shared=cache.match(a)[1])
+    assert cache.insert(a, kv.block_table[sc]) == 0
+    cache.check()
+    for s in (sa, sb, sc):
+        kv.release(s)
+    cache.check()
+    cache.clear()
+    assert kv.free_pages() == kv.num_pages - 1
+
+
+def test_radix_lru_evicts_least_recently_matched_leaf():
+    kv, cache = _kv_and_cache(num_slots=2, page=4, max_len=32, max_pages=2)
+    old = np.arange(1, 7, dtype=np.int32)               # 1 cacheable page
+    new = np.arange(30, 36, dtype=np.int32)
+    s = _fake_prefill(kv, cache, old)
+    kv.release(s)
+    assert cache.pages_held == 1
+    cache.match(old)                                    # stamp old as MRU
+    s = _fake_prefill(kv, cache, new)                   # fits: 2 pages max
+    kv.release(s)
+    assert cache.pages_held == 2
+    cache.match(new)                                    # now OLD is LRU
+    third = np.arange(50, 56, dtype=np.int32)
+    s = _fake_prefill(kv, cache, third)                 # evicts to fit
+    kv.release(s)
+    assert cache.match(old)[0] == 0                     # LRU victim gone
+    assert cache.match(new)[0] == 4                     # MRU survived
+    assert cache.pages_held <= 2
+    cache.check()
+
+
+def test_radix_evict_for_free_spares_pages_backing_live_slots():
+    kv, cache = _kv_and_cache(num_slots=2, page=4, max_len=32)
+    prompt = np.arange(1, 13, dtype=np.int32)
+    slot = _fake_prefill(kv, cache, prompt)             # slot still LIVE
+    free_before = kv.free_pages()
+    # dropping the node releases the CACHE's reference, but the pages
+    # stay allocated to the running slot — the pool grows by nothing
+    freed = cache.evict_for_free(2)
+    assert freed == 0
+    assert cache.pages_held == 0
+    assert kv.free_pages() == free_before
+    kv.release(slot)                                    # now they free
+    assert kv.free_pages() == kv.num_pages - 1
+    cache.check()
+
+
+def test_radix_max_pages_budget_truncates_retention():
+    kv, cache = _kv_and_cache(num_slots=2, page=4, max_len=32, max_pages=1)
+    prompt = np.arange(1, 14, dtype=np.int32)           # 3 cacheable pages
+    slot = kv.admit(len(prompt) + 2)
+    assert cache.insert(prompt, kv.block_table[slot]) == 1
+    assert cache.pages_held == 1                        # budget, not demand
+    assert cache.match(prompt)[0] == 4
+    kv.release(slot)
+    cache.check()
+
+
+def test_radix_refcount_conservation_property():
+    """Randomized soak: interleaved admit/insert/release/evict/clear
+    must keep exact page conservation at every step — every page free,
+    or held by exactly its refcount of owners, trash page untouched."""
+    rng = np.random.default_rng(7)
+    kv, cache = _kv_and_cache(num_slots=3, page=4, max_len=32, max_pages=8)
+    # a tiny prefix pool makes radix collisions (splits, re-inserts) common
+    pool = [rng.integers(1, 50, size=12).astype(np.int32) for _ in range(3)]
+    live: list[int] = []
+    for _ in range(200):
+        op = rng.integers(0, 10)
+        if op <= 5:                                     # admit + insert
+            base = pool[int(rng.integers(0, len(pool)))]
+            sfx = rng.integers(1, 50,
+                               size=int(rng.integers(0, 6))).astype(np.int32)
+            prompt = np.concatenate([base[:int(rng.integers(5, 13))], sfx])
+            total = len(prompt) + int(rng.integers(1, 4))
+            if total > kv.max_len:
+                continue
+            cached, pages = cache.match(prompt)
+            short = (kv.pages_for(total) - len(pages)) - kv.free_pages()
+            if short > 0:
+                cache.evict_for_free(short)
+                cached, pages = cache.match(prompt)
+            if not kv.can_admit(total, shared_pages=len(pages)):
+                continue
+            slot = kv.admit(total, shared=pages)
+            cache.insert(prompt, kv.block_table[slot])
+            live.append(slot)
+        elif op <= 7 and live:                          # finish a request
+            kv.release(live.pop(int(rng.integers(0, len(live)))))
+        elif op == 8:                                   # LRU pressure
+            cache.evict_nodes(int(rng.integers(1, 4)))
+        else:                                           # epoch fence
+            cache.clear()
+        cache.check()                                   # includes kv.check()
+    for slot in live:
+        kv.release(slot)
+    cache.clear()
+    cache.check()
+    assert kv.free_pages() == kv.num_pages - 1
+    assert cache.pages_held == 0 and kv.ref[0] == 0
+
+
+# -- n-gram drafter (no model) ------------------------------------------------
+
+def test_ngram_drafter_prompt_lookup():
+    from distlearn_tpu.serve.speculate import NGramDrafter
+    d = NGramDrafter(k=4, n_max=3)
+    # ...5,6,7 occurred earlier followed by 8,9 — draft continues it
+    assert d.propose([5, 6, 7, 8, 9, 1, 5, 6, 7]) == [8, 9, 1, 5]
+    # most RECENT earlier occurrence wins over the older one
+    assert d.propose([2, 9, 3, 2, 9, 4, 2, 9]) == [4, 2, 9]
+    # budget clips the draft; a never-repeating context drafts nothing
+    assert d.propose([5, 6, 7, 8, 9, 1, 5, 6, 7], k=1) == [8]
+    assert d.propose([1, 2, 3, 4, 5]) == []
+    with pytest.raises(ValueError):
+        NGramDrafter(k=0)
+    with pytest.raises(ValueError):
+        NGramDrafter(n_max=1, n_min=2)
+
+
+# -- engine: cached prefix / speculation / sampling / chunking ----------------
+
+@pytest.fixture(scope="module")
+def eng(lm_params):
+    """One shared engine for the whole module: every test drains its
+    slots (and clears any prefix cache it built) before returning, so
+    the jitted tick/prefill/chunk/verify programs compile once."""
+    from distlearn_tpu.serve.engine import DecodeEngine
+    return DecodeEngine(lm_params, num_slots=2, max_len=MAX_LEN, page=8)
+
+
+def _decode(eng, slot, first, steps):
+    toks = [first]
+    while len(toks) < steps:
+        toks.append(eng.tick()[slot])
+    eng.finish(slot)
+    return toks
+
+
+def test_cached_prefix_decode_parity(lm_params, eng):
+    from distlearn_tpu.serve.prefix_cache import RadixPrefixCache
+    cache = RadixPrefixCache(eng.cache)
+    rng = np.random.default_rng(11)
+    base = rng.integers(1, VOCAB, size=20).astype(np.int32)
+    slot, first = eng.admit(base, 4)
+    cache.insert(base, eng.cache.block_table[slot])
+    _decode(eng, slot, first, 4)
+    # 90%-overlap variant: shares both cacheable pages (16 of 20 toks)
+    variant = base.copy()
+    variant[18:] = (variant[18:] % (VOCAB - 1)) + 1
+    cached, pages = cache.match(variant)
+    assert cached == 16 and len(pages) == 2
+    job = eng.begin(variant, 6, shared=pages)
+    assert job.cached == 16
+    first = None
+    while first is None:
+        first = eng.prefill_step(job)
+    toks = _decode(eng, job.slot, first, 6)
+    # the suffix-only prefill over adopted pages is token-exact
+    assert toks == _greedy_ref(lm_params, variant, 6)
+    cache.check()
+    cache.clear()
+    assert eng.cache.free_pages() == eng.cache.num_pages - 1
+
+
+def test_verify_greedy_equivalence_and_implicit_rollback(lm_params, eng):
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, VOCAB, size=7).astype(np.int32)
+    ref = _greedy_ref(lm_params, prompt, 10)
+    slot, first = eng.admit(prompt, 10)
+    assert first == ref[0]
+    toks = [first]
+    # round 1: a deliberately wrong draft — all rejected, the dispatch
+    # still advances exactly like a plain tick (1 token, the argmax)
+    out = eng.verify({slot: [(ref[1] + 1) % VOCAB, (ref[2] + 3) % VOCAB]})
+    assert out[slot] == [ref[1]]
+    toks += out[slot]
+    # round 2 decodes PAST the rejected positions: their stale K/V must
+    # be overwritten in place (implicit rollback — no restore pass)
+    out = eng.verify({slot: ref[2:5]})          # perfect draft: k+1 toks
+    assert out[slot] == ref[2:6]
+    toks += out[slot]
+    # round 3: first draft right, second wrong -> accept 1 + bonus
+    out = eng.verify({slot: [ref[6], (ref[7] + 1) % VOCAB]})
+    assert out[slot] == ref[6:8]
+    toks += out[slot]
+    while len(toks) < 10:                       # tail on the plain tick
+        toks.append(eng.tick()[slot])
+    eng.finish(slot)
+    assert toks == ref
+    eng.cache.check()
+
+
+def test_scheduler_speculates_exactly(lm_params, eng):
+    """The drafter-wired scheduler must stream token-identical output
+    to plain greedy — speculation is a dispatch-count trade only."""
+    from distlearn_tpu.serve.scheduler import Scheduler
+    from distlearn_tpu.serve.speculate import NGramDrafter
+    sched = Scheduler(eng, drafter=NGramDrafter())
+    prompt = np.tile(np.array([3, 5, 7], np.int32), 8)  # self-quoting
+    rid = sched.submit(prompt, 16)
+    toks, done, verified = [], False, False
+    for _ in range(200):
+        for ev in sched.step():
+            if ev.kind == "token" and ev.rid == rid:
+                toks.append(ev.token)
+                if ev.accepted is not None:
+                    verified = True
+            elif ev.kind == "finish" and ev.rid == rid:
+                done = True
+        if done:
+            break
+    assert done and toks == _greedy_ref(lm_params, prompt, 16)
+    assert verified          # the verify path actually ran
+    eng.cache.check()
+
+
+def test_sampling_deterministic_and_temp0_bitwise(lm_params, eng):
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, VOCAB, size=7).astype(np.int32)
+    ref = _greedy_ref(lm_params, prompt, 8)
+
+    def run(**kw):
+        slot, first = eng.admit(prompt, 8, **kw)
+        return _decode(eng, slot, first, 8)
+
+    # same seed -> bitwise-identical sampled stream, across admissions
+    a = run(temperature=0.9, top_k=12, top_p=0.95, seed=123)
+    b = run(temperature=0.9, top_k=12, top_p=0.95, seed=123)
+    assert a == b
+    # a hot-enough draw diverges from greedy for SOME seed
+    assert any(run(temperature=3.0, seed=s) != ref for s in (7, 8, 9))
+    # temp=0 is bitwise argmax even while batched WITH a sampled stream
+    s_hot, f_hot = eng.admit(prompt, 8, temperature=1.5, seed=42)
+    s_cold, f_cold = eng.admit(prompt, 8)
+    assert f_cold == ref[0]
+    cold = [f_cold]
+    while len(cold) < 8:
+        cold.append(eng.tick()[s_cold])
+    assert cold == ref
+    eng.finish(s_hot)
+    eng.finish(s_cold)
+    with pytest.raises(ValueError):
+        eng.begin(prompt, 4, temperature=-0.5)
+    with pytest.raises(ValueError):
+        eng.begin(prompt, 4, top_p=1.5)
+    eng.cache.check()
+
+
+def test_chunked_prefill_token_parity(lm_params, eng):
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, VOCAB, size=20).astype(np.int32)
+    ref = _greedy_ref(lm_params, prompt, 6)
+    # chunk bound >= prompt takes the original full-bucket program
+    slot, first = eng.admit(prompt, 6)
+    assert _decode(eng, slot, first, 6) == ref
+    # chunked resume (7+7+6 positions) must land on the same stream
+    job = eng.begin(prompt, 6)
+    first = None
+    while first is None:
+        first = eng.prefill_step(job, chunk=7)
+    assert _decode(eng, job.slot, first, 6) == ref
+    eng.cache.check()
+
+
+# -- scheduler: chunked prefill protects TPOT (injected clock) ----------------
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_chunked_prefill_never_stalls_running_streams(lm_params, eng):
+    from distlearn_tpu.serve.scheduler import Scheduler
+    clk = _Clock()
+    sched = Scheduler(eng, clock=clk, prefill_chunk=8)
+    rng = np.random.default_rng(4)
+    short = rng.integers(1, VOCAB, size=5).astype(np.int32)
+    long = rng.integers(1, VOCAB, size=40).astype(np.int32)
+    rid_s = sched.submit(short, 12)
+    clk.now += 1.0
+    assert any(ev.kind == "token" and ev.first
+               for ev in sched.step())          # short stream is running
+    rid_l = sched.submit(long, 4)
+    stamps, first_long_at = [], None
+    for _ in range(20):
+        clk.now += 1.0
+        for ev in sched.step():
+            if ev.kind == "token" and ev.rid == rid_s:
+                stamps.append(clk.now)
+            elif (ev.kind == "token" and ev.rid == rid_l
+                    and first_long_at is None):
+                first_long_at = clk.now
+        if first_long_at is not None:
+            break
+    assert first_long_at is not None
+    # the 40-token prompt needed >= ceil(40/8) bounded-chunk rounds...
+    assert first_long_at - 1.0 >= 40 / 8
+    # ...and the running stream got a token EVERY round meanwhile: its
+    # TPOT never exceeds one scheduling round while the prefill chunks
+    gaps = np.diff([1.0] + stamps)
+    assert len(stamps) >= 5 and (gaps == 1.0).all()
+    sched.cancel(rid_s)
+    sched.cancel(rid_l)
+    eng.cache.check()
+
+
+def test_idle_burst_prefill_completes_in_one_round(lm_params, eng):
+    from distlearn_tpu.serve.scheduler import Scheduler
+    sched = Scheduler(eng, clock=_Clock(), prefill_chunk=8)
+    rng = np.random.default_rng(6)
+    long = rng.integers(1, VOCAB, size=30).astype(np.int32)
+    rid = sched.submit(long, 2)
+    # nobody is decoding, so there is nobody to stall: the whole prompt
+    # prefills (and the first token lands) in the admission round
+    evs = sched.step()
+    assert any(ev.kind == "token" and ev.rid == rid and ev.first
+               for ev in evs)
+    sched.cancel(rid)
+    eng.cache.check()
+
+
+# -- DL310: new frame fields stay bound ---------------------------------------
+
+def test_dl310_raw_speed_fields_are_bound():
+    from distlearn_tpu.lint.conformance import (SERVE_FRAME_BINDINGS,
+                                                lint_serve_frames)
+    assert {"temperature", "top_k", "top_p", "seed",
+            "speculate"} <= set(SERVE_FRAME_BINDINGS["G"])
+    assert {"accepted", "cached_tokens"} <= set(SERVE_FRAME_BINDINGS["R"])
+    assert "cached_pages" in SERVE_FRAME_BINDINGS["J"]
+    assert lint_serve_frames() == []
+
+
+def test_dl310_renamed_accepted_field_fires_both_ways():
+    """Renaming ``accepted`` across every producer/consumer leaves the
+    committed binding stale AND ships an unbound field — both fire."""
+    import inspect
+    from distlearn_tpu.lint.conformance import lint_serve_frames
+    from distlearn_tpu.serve import client, router, server
+
+    def ren(mod):
+        return inspect.getsource(mod).replace('"accepted"', '"accepted_n"')
+
+    fs = lint_serve_frames(server_source=ren(server),
+                           router_source=ren(router),
+                           client_source=ren(client))
+    wheres = sorted(f.where for f in fs)
+    assert all(f.rule == "DL310" for f in fs)
+    assert wheres == ["serve_frames.R.accepted",
+                      "serve_frames.R.accepted_n"]
+
+
+def test_dl310_ghost_speculation_knob_fires():
+    """A new 'G' sampling/speculation knob shipped without a binding is
+    undocumented wire surface."""
+    import inspect
+    from distlearn_tpu.lint.conformance import lint_serve_frames
+    from distlearn_tpu.serve import client
+    src = inspect.getsource(client) + (
+        '\n\ndef _ghost(msg):\n    msg["draft_k"] = 2\n')
+    fs = lint_serve_frames(client_source=src)
+    assert [f.rule for f in fs] == ["DL310"]
+    assert fs[0].where == "serve_frames.G.draft_k"
+
+
+# -- diststat raw-speed table -------------------------------------------------
+
+def test_diststat_raw_speed_table():
+    import diststat
+    tab = diststat.raw_speed_table(
+        {"serve_prefix_cache_hits_total": 8,
+         "serve_prefix_cache_misses_total": 2,
+         "serve_prefix_cache_evictions_total": 1,
+         "serve_engine_verifies_total": 5,
+         "serve_engine_prefill_chunks_total": 3},
+        {"serve_prefix_cache_pages": 4},
+        {"serve_spec_accepted_tokens": {"sum": 18.0, "count": 10,
+                                        "buckets": {}, "inf": 0}},
+        {"serve.verify": [0.01] * 5, "serve.prefill_chunk": [0.002] * 3})
+    assert tab["prefix_cache"]["hits"] == 8
+    assert abs(tab["prefix_cache"]["hit_rate"] - 0.8) < 1e-9
+    assert tab["prefix_cache"]["pages_retained"] == 4
+    assert abs(tab["speculation"]["accepted_tokens_per_tick"] - 1.8) < 1e-9
+    assert tab["speculation"]["verify_dispatches"] == 5
+    assert tab["prefill_chunks"] == 3
+    assert set(tab["latency"]) == {"verify", "prefill_chunk"}
+    # a run that never used the features renders an empty table
+    assert diststat.raw_speed_table({}, {}, {}, {}) == {}

@@ -62,18 +62,24 @@ Four more scenarios drive the SERVING fleet (docs/SERVING.md): a
 * ``overload_shed``       — a saturated fleet refuses with RouterBusy +
   ``retry_after`` at both the router watermark and the replica's
   QueueFull, then admits again once drained;
-* ``swap_during_traffic`` — an epoch-2 checkpoint lands under load;
-  zero failed streams, zero fence violations, no stream observes two
-  epochs.
+* ``swap_during_traffic`` — an epoch-2 checkpoint lands under shared-
+  prefix load with the radix prefix cache on; zero failed streams,
+  zero fence violations, no stream observes two epochs, and the swap
+  must invalidate the cache — post-swap repeats of pre-swap prompts
+  are checked against a fresh epoch-2 reference (zero stale-KV
+  streams).
 
 Three TRAFFIC scenarios exercise the observability plane end-to-end
 (docs/OBSERVABILITY.md) — realistic request mixes instead of injected
 faults —
 
-* ``zipf_mix``     — Zipf-popularity request catalog over a 2-replica
-  fleet; every repeat of a prompt must decode to the identical token
-  stream on whichever replica served it (greedy decode is a fleet-wide
-  contract), and the obs counters must account for every request;
+* ``zipf_mix``     — Zipf-popularity shared-prefix catalog (a system-
+  prompt pool) over a 2-replica fleet with the radix prefix cache on;
+  every repeat of a prompt must decode to the identical token stream
+  whether its prefill came from compute or cached pages (greedy decode
+  is a fleet-wide contract), cache hits and ``cached_tokens`` must
+  surface, TTFT p95 must hold, and the obs counters must account for
+  every request;
 * ``diurnal``      — a one-day sine of wave sizes against one replica;
   the windowed TTFT-p95 SLO must breach at the peak and recover once
   the trough traffic leaves the window (``slo_breaches_total`` /
@@ -901,6 +907,54 @@ def _serve_prompts(n, seed):
             for _ in range(n)]
 
 
+def _prefixed_prompts(n, seed, *, pool=3, prefix_len=24):
+    """Shared-prefix catalog: each prompt is a 'system prompt' drawn
+    from a small pool (page-aligned length, so the radix prefix cache
+    can retain it) plus a short unique suffix — the traffic shape the
+    prefix cache exists for."""
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, _SERVE_LM["vocab"],
+                             size=prefix_len).astype(np.int32)
+                for _ in range(pool)]
+    out = []
+    for i in range(n):
+        sfx = rng.integers(1, _SERVE_LM["vocab"],
+                           size=int(rng.integers(4, 9))).astype(np.int32)
+        out.append(np.concatenate([prefixes[i % pool], sfx]))
+    return out
+
+
+def _hist_sample(snap, name):
+    for fam in snap:
+        if fam["name"] == name and fam["samples"]:
+            return fam["samples"][0]
+    return None
+
+
+def _hist_p95(sample, base=None):
+    """p95 upper bound from a snapshot histogram (the smallest bucket
+    edge covering 95% of observations; inf when the tail spilled past
+    the last bound).  ``base`` subtracts an earlier snapshot so warmup
+    samples (jit compiles) don't pollute the steady-state quantile."""
+    if sample is None:
+        return None
+    buckets = dict(sample["buckets"])
+    count = sample["count"]
+    if base is not None:
+        for k in buckets:
+            buckets[k] -= base["buckets"].get(k, 0)
+        count -= base["count"]
+    if count <= 0:
+        return None
+    need = math.ceil(0.95 * count)
+    cum = 0
+    for b in sorted(float(k) for k in buckets):
+        cum += buckets[str(b)]
+        if cum >= need:
+            return b
+    return float("inf")
+
+
 def _spawn_replicas(host, port, n, params, *, num_slots=2, **server_kw):
     """N independent single-process replicas on consecutive ports, each
     with its own engine and KV cache (shared-nothing, like the real
@@ -1171,21 +1225,28 @@ def _scenario_overload_shed(rounds, seed, host):
 
 
 def _scenario_swap_during_traffic(rounds, seed, host):
-    """Epoch-fenced hot weight swap under live traffic: both replicas
-    tail one checkpoint directory; a new center (epoch 2) lands mid-
-    wave.  The fence must hold — zero failed streams, zero fence
-    violations, every stream pinned to exactly one epoch (the 'R'-chunk
-    echo), both replicas converging to epoch 2 and serving post-swap
-    traffic entirely there."""
+    """Epoch-fenced hot weight swap under live SHARED-PREFIX traffic
+    with the radix prefix cache on: both replicas tail one checkpoint
+    directory; a new center (epoch 2) lands mid-wave while cached
+    KV pages from epoch-1 prefills are live in both caches.  The fence
+    must hold — zero failed streams, zero fence violations, every
+    stream pinned to exactly one epoch (the 'R'-chunk echo), both
+    replicas converging to epoch 2 — AND the swap must invalidate the
+    prefix cache: post-swap repeats of pre-swap catalog prompts are
+    decoded against a fresh epoch-2 reference engine, so a single
+    stale epoch-1 KV page surviving the fence shows up as a diverged
+    stream (zero tolerated)."""
+    from distlearn_tpu.models.transformer import greedy_generate
     from distlearn_tpu.serve.router import Router
     from distlearn_tpu.utils.checkpoint import save_checkpoint
     params = _lm_params()
     port = _reserve_window(2, host)
     ckpt_dir = tempfile.mkdtemp(prefix="chaos-swap-")
     servers = _spawn_replicas(host, port, 2, params, ckpt_dir=ckpt_dir,
-                              ckpt_poll=0.02, epoch=1)
+                              ckpt_poll=0.02, epoch=1, prefix_cache=True)
     total = rounds * 2
     swap_at = total // 3
+    catalog = _prefixed_prompts(6, seed)
     next_params = {}
     failures: list = []
     try:
@@ -1201,8 +1262,8 @@ def _scenario_swap_during_traffic(rounds, seed, host):
                                     metadata={"epoch": 2})
 
             results, hung = _fleet_load(
-                router, _serve_prompts(total, seed), 6,
-                stagger=0.02, on_index=_fault)
+                router, [catalog[i % len(catalog)] for i in range(total)],
+                6, stagger=0.02, on_index=_fault)
             deadline = time.monotonic() + CHAOS_RECOVER_S
             while time.monotonic() < deadline:
                 if all(s.epoch == 2 for s in servers):
@@ -1211,14 +1272,17 @@ def _scenario_swap_during_traffic(rounds, seed, host):
             else:
                 failures.append(f"replicas never converged to epoch 2: "
                                 f"{[s.epoch for s in servers]}")
-            post, hung_post = _fleet_load(
-                router, _serve_prompts(4, seed + 1), 4)
+            # post-swap wave REPEATS pre-swap catalog prompts: their
+            # prefixes were cached under epoch-1 weights, so stale pages
+            # surviving the fence would feed these prefills
+            post, hung_post = _fleet_load(router, catalog[:4], 4)
     finally:
         _stop_replicas(servers)
         shutil.rmtree(ckpt_dir, ignore_errors=True)
     totals = _totals(core.REGISTRY.snapshot())
     swaps = totals.get("serve_weight_swaps_total", 0)
     fences = totals.get("router_fence_violations_total", 0)
+    hits = totals.get("serve_prefix_cache_hits_total", 0)
     done = [r for r in results
             if isinstance(r, dict) and r["reason"] == "complete"]
     epochs_seen = sorted({r["epoch"] for r in done})
@@ -1238,15 +1302,31 @@ def _scenario_swap_during_traffic(rounds, seed, host):
     if 1 not in epochs_seen:
         failures.append("no stream completed on the pre-swap epoch "
                         "(swap landed before traffic?)")
+    if hits < 1:
+        failures.append("the prefix cache never engaged — the "
+                        "invalidation check proved nothing")
     bad_post = [r for r in post
                 if not (isinstance(r, dict) and r["reason"] == "complete"
                         and r["epoch"] == 2)]
     if bad_post:
         failures.append(f"post-swap traffic not entirely on epoch 2: "
                         f"{bad_post[:3]!r}")
+    stale = 0
+    for i, r in enumerate(post):
+        if not isinstance(r, dict) or r["reason"] != "complete":
+            continue
+        want = np.asarray(greedy_generate(
+            next_params, catalog[i][None], 4))[0].tolist()
+        if r["tokens"] != want:
+            stale += 1
+            failures.append(
+                f"STALE KV past the epoch fence: post-swap stream for "
+                f"catalog[{i}] decoded {r['tokens']} on epoch-2 weights, "
+                f"reference says {want}")
     return {"requests": total, "completed": len(done),
             "stream_epochs": epochs_seen, "swaps": swaps,
-            "fence_violations": fences}, failures
+            "fence_violations": fences, "prefix_cache_hits": hits,
+            "stale_kv_streams": stale}, failures
 
 
 # ---------------------------------------------------------------------------
@@ -1273,30 +1353,46 @@ _TTFT_RULE = {"name": "ttft-p95", "kind": "quantile",
 
 
 def _scenario_zipf_mix(rounds, seed, host):
-    """Zipf-popularity request catalog over a 2-replica fleet: a few
-    head prompts dominate, the tail is long.  Greedy decode is a
-    fleet-wide contract — every repeat of a catalog prompt must produce
-    the IDENTICAL token stream no matter which replica served it — and
-    the obs counters must account for every request."""
+    """Zipf-popularity SHARED-PREFIX catalog over a 2-replica fleet
+    with the radix prefix cache on: a few head prompts dominate, every
+    prompt opens with a system prompt from a 3-entry pool, so repeats
+    and siblings hit cached KV pages.  Greedy decode is a fleet-wide
+    contract — every repeat of a catalog prompt must produce the
+    IDENTICAL token stream whether its prefill came from compute or
+    from cached pages, on whichever replica served it.  The cache must
+    actually engage (hits counted, ``cached_tokens`` surfaced on the
+    wire) and the steady-state TTFT p95 must hold — cache churn under
+    page pressure may not degrade into re-prefill storms or stalls."""
     from distlearn_tpu.serve.router import Router
     params = _lm_params()
     port = _reserve_window(2, host)
-    servers = _spawn_replicas(host, port, 2, params)
-    catalog = _serve_prompts(10, seed)
+    servers = _spawn_replicas(host, port, 2, params, prefix_cache=True)
+    catalog = _prefixed_prompts(10, seed)
     weights = 1.0 / np.arange(1, 11) ** 1.5
     weights /= weights.sum()
     total = rounds * 3
     idx = np.random.default_rng(seed).choice(10, size=total, p=weights)
     try:
+        # a tight health_ttl: with the cache on, requests drain fast
+        # enough that a stale load snapshot would pin the whole wave to
+        # the tie-winning list head
+        # warm EVERY replica's compiled paths (prefill buckets,
+        # cached-suffix chunks, the tick) so the asserted wave measures
+        # steady state, not jit compiles — a fleet-wide router would
+        # send the whole warmup to one fast replica
+        for i in range(2):
+            with Router([(host, port + i)], dial_deadline=1.0) as warm:
+                _fleet_load(warm, catalog[:4], 4)
         with Router([(host, port + i) for i in range(2)],
-                    health_ttl=0.05, dial_deadline=1.0) as router:
+                    health_ttl=0.005, dial_deadline=1.0) as router:
+            snap0 = core.REGISTRY.snapshot()
             results, hung = _fleet_load(
-                router, [catalog[int(k)] for k in idx], 4, stagger=0.01)
+                router, [catalog[int(k)] for k in idx], 4, stagger=0.003)
     finally:
         _stop_replicas(servers)
     snap = core.REGISTRY.snapshot()
+    totals = _totals(snap)
     dispatched = _labeled(snap, "router_dispatch_total")
-    outcomes = _labeled(snap, "serve_requests_total")
     done = [r for r in results
             if isinstance(r, dict) and r["reason"] == "complete"]
     failures = []
@@ -1311,22 +1407,46 @@ def _scenario_zipf_mix(rounds, seed, host):
             streams.setdefault(int(k), set()).add(tuple(r["tokens"]))
     skewed = {k: len(v) for k, v in streams.items() if len(v) != 1}
     if skewed:
-        failures.append("replicas disagreed on repeated prompts "
-                        f"(prompt -> distinct streams): {skewed}")
+        failures.append("cached and uncached prefills disagreed on "
+                        "repeated prompts (prompt -> distinct streams): "
+                        f"{skewed}")
     if len(dispatched) < 2:
         failures.append("the mix never spread past one replica")
     counts = np.bincount(idx, minlength=10)
     if counts.max() < total / 4:
         failures.append(f"the zipf draw lost its head: {counts.tolist()}")
-    completed_ctr = sum(v for lbl, v in outcomes.items()
-                        if "complete" in str(lbl))
+    completed_ctr = (
+        sum(v for lbl, v in _labeled(snap, "serve_requests_total").items()
+            if "complete" in str(lbl))
+        - sum(v for lbl, v in _labeled(snap0, "serve_requests_total")
+              .items() if "complete" in str(lbl)))
     if completed_ctr != len(done):
         failures.append(f"serve_requests_total{{complete}} = "
                         f"{completed_ctr} != {len(done)} completions")
+    hits = totals.get("serve_prefix_cache_hits_total", 0)
+    if hits < total // 8:
+        failures.append(f"prefix cache never engaged: {hits} hits over "
+                        f"{total} shared-prefix requests")
+    if not any(r.get("cached_tokens") for r in done):
+        failures.append("no stream reported cached_tokens despite the "
+                        "shared-prefix catalog")
+    # a generous absolute bound: the burst queues ~30 deep on 4 slots,
+    # so p95 mostly measures queue wait (~1s here); the bound catches a
+    # cache bug degenerating into admission stalls or retry storms
+    # (deadlocks read as inf), not machine-speed jitter
+    p95 = _hist_p95(_hist_sample(snap, "serve_ttft_seconds"),
+                    _hist_sample(snap0, "serve_ttft_seconds"))
+    if p95 is None or p95 > 5.0:
+        failures.append(f"TTFT p95 did not hold under shared-prefix "
+                        f"traffic: {p95}")
     return {"requests": total, "completed": len(done),
             "head_share": round(float(counts.max()) / total, 3),
             "distinct_prompts": int((counts > 0).sum()),
-            "replicas_dispatched": len(dispatched)}, failures
+            "replicas_dispatched": len(dispatched),
+            "prefix_cache_hits": hits,
+            "cached_streams": sum(1 for r in done
+                                  if r.get("cached_tokens")),
+            "ttft_p95": p95}, failures
 
 
 def _scenario_diurnal(rounds, seed, host):

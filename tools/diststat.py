@@ -16,8 +16,12 @@ computed from the individual span durations, not histogram buckets),
 final counter values (per label set and summed per name), gauges, and
 histogram summaries.  Multiple files merge: spans concatenate, counters
 sum across files (one file per process is the normal layout — server
-and each client spill separately).  ``diff`` subtracts run A's counter
-totals and span quantiles from run B's.
+and each client spill separately).  Serving runs additionally get the
+derived serving/router tables and the raw-speed table (radix
+prefix-cache hit rate and retained pages, speculative-decode accepted
+tokens per tick, chunked-prefill dispatch mix — docs/SERVING.md).
+``diff`` subtracts run A's counter totals and span quantiles from
+run B's.
 
 ``merge`` is the FLEET view (one trail per process): counters and span
 quantiles fleet-wide with a per-process breakdown column, histogram
@@ -390,6 +394,60 @@ def router_table(counter_totals: dict, counters: dict, spans: dict) -> dict:
     return tab
 
 
+_PREFIX_CACHE_FAMS = {
+    "serve_prefix_cache_hits_total": "hits",
+    "serve_prefix_cache_misses_total": "misses",
+    "serve_prefix_cache_evictions_total": "evictions",
+}
+_SPEC_SPANS = {"serve.prefill_chunk": "prefill_chunk",
+               "serve.verify": "verify"}
+
+
+def raw_speed_table(counter_totals: dict, gauges: dict,
+                    histograms: dict, spans: dict) -> dict:
+    """Derive the serving raw-speed table (docs/SERVING.md): radix
+    prefix-cache hit/miss/eviction counts with the hit rate and pages
+    still retained, the speculative-decode acceptance rate (mean tokens
+    emitted per slot per verify tick — 1.0 is plain-tick throughput,
+    anything above is the speculation win), and the chunked-prefill /
+    verify dispatch latencies.  Empty when neither the cache nor the
+    drafter ever ran."""
+    tab: dict = {}
+    cache = {col: counter_totals[fam]
+             for fam, col in _PREFIX_CACHE_FAMS.items()
+             if counter_totals.get(fam)}
+    if cache:
+        looked = cache.get("hits", 0) + cache.get("misses", 0)
+        if looked:
+            cache["hit_rate"] = cache.get("hits", 0) / looked
+        pages = gauges.get("serve_prefix_cache_pages")
+        if pages is not None:
+            cache["pages_retained"] = pages
+        tab["prefix_cache"] = cache
+    acc = histograms.get("serve_spec_accepted_tokens")
+    if acc and acc["count"]:
+        tab["speculation"] = {
+            "verify_slot_ticks": acc["count"],
+            "tokens_emitted": acc["sum"],
+            "accepted_tokens_per_tick": acc["sum"] / acc["count"],
+            "verify_dispatches": counter_totals.get(
+                "serve_engine_verifies_total", 0),
+        }
+    chunks = counter_totals.get("serve_engine_prefill_chunks_total", 0)
+    if chunks:
+        tab["prefill_chunks"] = chunks
+    lat = {}
+    for name, col in _SPEC_SPANS.items():
+        durs = spans.get(name)
+        if durs:
+            lat[col] = {"count": len(durs),
+                        "p50": _percentile(durs, 50),
+                        "p99": _percentile(durs, 99)}
+    if lat:
+        tab["latency"] = lat
+    return tab
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -422,7 +480,11 @@ def summarize_run(paths: list[str]) -> dict:
             "serving": serving_table(run["counter_totals"],
                                      run["counters"], run["spans"]),
             "router": router_table(run["counter_totals"],
-                                   run["counters"], run["spans"])}
+                                   run["counters"], run["spans"]),
+            "raw_speed": raw_speed_table(run["counter_totals"],
+                                         run["gauges"],
+                                         run["histograms"],
+                                         run["spans"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -782,6 +844,28 @@ def _print_summary(doc: dict):
             if col in rt:
                 print(f"  {col} = {rt[col]:g}")
         for name, row in rt.get("latency", {}).items():
+            print(f"  {name}: count={row['count']} "
+                  f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
+        print()
+    if doc.get("raw_speed"):
+        rs = doc["raw_speed"]
+        print("raw speed (prefix cache / speculation):")
+        pc = rs.get("prefix_cache")
+        if pc:
+            for col in ("hits", "misses", "evictions", "pages_retained"):
+                if col in pc:
+                    print(f"  cache {col} = {pc[col]:g}")
+            if "hit_rate" in pc:
+                print(f"  cache hit_rate = {pc['hit_rate']:.2f}")
+        sp = rs.get("speculation")
+        if sp:
+            print(f"  spec accepted_tokens_per_tick = "
+                  f"{sp['accepted_tokens_per_tick']:.2f} "
+                  f"(over {sp['verify_slot_ticks']:g} slot-ticks, "
+                  f"{sp['verify_dispatches']:g} verify dispatches)")
+        if "prefill_chunks" in rs:
+            print(f"  prefill_chunks = {rs['prefill_chunks']:g}")
+        for name, row in rs.get("latency", {}).items():
             print(f"  {name}: count={row['count']} "
                   f"p50={_fmt_s(row['p50'])} p99={_fmt_s(row['p99'])}")
 
