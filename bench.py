@@ -985,6 +985,164 @@ def host_allreduce_bench(size_mb: int = 16, n: int = 4, iters: int = 5):
     return out
 
 
+def _host_sync_hybrid_child(rank, hosts, local, port, nelem, iters, bps,
+                            conn):
+    """One hybrid host rank in its own process (module-level for
+    multiprocessing spawn): its private XLA runtime hosts the L-device
+    mesh; the TCP leg joins the other host over real localhost sockets.
+    Reports ``(host_leg_nic_bytes_per_sync, timed_seconds)``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={local}")
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.comm.backend import HybridBackend
+
+    b = HybridBackend(rank, hosts, "127.0.0.1", port,
+                      num_devices=local, base=2)
+    if bps is not None:
+        for c in b.host_leg._links():
+            c.throttle_bps = bps
+    rows = np.stack([
+        np.random.RandomState(rank * local + i).randn(nelem)
+        .astype(np.float32) for i in range(local)])
+    b.all_reduce(rows)                            # warmup (jit + caches)
+    b.barrier()
+    nic0 = b.host_leg.nic_bytes()
+    b.all_reduce(rows)
+    nic = b.host_leg.nic_bytes() - nic0
+    b.barrier()
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        b.all_reduce(rows)
+    dt = _t.perf_counter() - t0
+    b.close()
+    conn.send((nic, dt))
+    conn.close()
+
+
+def host_sync_bench(size_mb: int = 2, hosts: int = 2, local: int = 8,
+                    iters: int = 3):
+    """Collective-backend comparison (ISSUE 20): the same H*L-node
+    allreduce through (a) ``HostBackend`` — every logical node its own
+    TCP tree rank, the flat reference topology — vs (b)
+    ``HybridBackend`` — L device-nodes behind ONE TCP rank per host,
+    in-mesh reduce-scatter / host tree leg / in-mesh all-gather.
+
+    Two measurements per backend:
+
+    * **Host-leg bytes per host** (unthrottled, MEASURED off
+      ``Conn.bytes_sent + bytes_received``): the busiest host's total
+      TCP traffic for one sync.  Flat: each of a host's L ranks moves
+      >= 2T up+down, so >= 2*L*T per host.  Hybrid: ~2T — the
+      hierarchical win is ~L-fold, structural, independent of wall
+      clock.
+    * **Syncs/s on an emulated slow link** (every conn paced to
+      ``BENCH_HOST_EMULATED_LINK_MB_S``, default 200 — the multi-host
+      DCN regime): fewer bytes through the bottleneck = more syncs/s.
+
+    The flat topology is localhost threads (no device work); each
+    hybrid host rank is its OWN process — one XLA runtime per host, as
+    deployed — so the two hosts' in-mesh shard_map collectives cannot
+    cross-join one process's rendezvous.
+    """
+    import multiprocessing as _mp
+    import time as _t
+
+    import numpy as np
+
+    from distlearn_tpu.comm.backend import HostBackend
+    from distlearn_tpu.comm.tree import LocalhostTree, tree_map_spawn
+
+    n = hosts * local
+    nelem = size_mb * 1024 * 1024 // 4
+    payload = nelem * 4
+
+    def _run_flat(bps=None):
+        """Flat HostBackend: warmup sync, NIC-byte-metered sync, then
+        ``iters`` timed syncs (throttled when ``bps``).  Returns
+        (max per-host host-leg bytes, sec_per_sync)."""
+        port = _reserve_port_window(1)
+
+        def node(rank):
+            b = HostBackend(LocalhostTree(rank, n, port, base=2))
+            if bps is not None:
+                for c in b.handle._links():
+                    c.throttle_bps = bps
+            v = np.random.RandomState(rank).randn(nelem).astype(np.float32)
+            b.all_reduce(v)                       # warmup
+            b.barrier()
+            nic0 = b.handle.nic_bytes()
+            b.all_reduce(v)
+            nic = b.handle.nic_bytes() - nic0
+            b.barrier()
+            t0 = _t.perf_counter()
+            for _ in range(iters):
+                b.all_reduce(v)
+            dt = _t.perf_counter() - t0
+            b.close()
+            return nic, dt
+        res = tree_map_spawn(node, n, timeout=600)
+        # a "host" is a group of L adjacent ranks; its NIC moves the
+        # sum of their tree traffic
+        per_host = [sum(res[h * local + i][0] for i in range(local))
+                    for h in range(hosts)]
+        return max(per_host), max(r[1] for r in res) / iters
+
+    def _run_hybrid(bps=None):
+        port = _reserve_port_window(1)
+        ctx = _mp.get_context("spawn")
+        pipes, procs = [], []
+        for r in range(hosts):
+            rd, wr = ctx.Pipe(False)
+            p = ctx.Process(target=_host_sync_hybrid_child,
+                            args=(r, hosts, local, port, nelem, iters,
+                                  bps, wr))
+            p.start()
+            procs.append(p)
+            pipes.append(rd)
+        res = []
+        for rd in pipes:
+            if not rd.poll(570):
+                for p in procs:
+                    p.terminate()
+                raise TimeoutError("hybrid sync child did not report")
+            res.append(rd.recv())
+        for p in procs:
+            p.join(60)
+        return max(r[0] for r in res), max(r[1] for r in res) / iters
+
+    bus = lambda t: (2 * (n - 1) / n) * payload / t / 1e9  # noqa: E731
+    bps = float(os.environ.get("BENCH_HOST_EMULATED_LINK_MB_S",
+                               "200")) * 1e6
+
+    flat_bytes, flat_t = _run_flat()
+    hyb_bytes, hyb_t = _run_hybrid()
+    _, flat_te = _run_flat(bps=bps)
+    _, hyb_te = _run_hybrid(bps=bps)
+
+    def row(host_bytes, t, te):
+        return {"host_leg_bytes_per_host": host_bytes,
+                "sec_per_sync": t, "busbw_gb_s": bus(t),
+                "sec_per_sync_emulated": te,
+                "syncs_per_sec_emulated": 1.0 / te,
+                "busbw_gb_s_emulated": bus(te)}
+
+    return {
+        "hosts": hosts, "local_devices": local, "logical_nodes": n,
+        "payload_mb": size_mb, "payload_bytes": payload,
+        "emulated_link_mb_s": bps / 1e6,
+        "host_backend": row(flat_bytes, flat_t, flat_te),
+        "hybrid_backend": row(hyb_bytes, hyb_t, hyb_te),
+        "host_leg_byte_reduction": flat_bytes / hyb_bytes,
+        "hybrid_sync_speedup_emulated": flat_te / hyb_te,
+    }
+
+
 #: EASGD-shaped pytree leaf lists for the wire microbench — the EXACT
 #: leaf shapes of the repo's models (distlearn_tpu/models/, hardcoded so
 #: the bench stays chip-free and jax-import-free): many small bias/bn
@@ -2394,6 +2552,25 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"[bench] host allreduce bench failed: {e}",
                   file=sys.stderr)
+        try:
+            details["host_sync"] = host_sync_bench(
+                int(os.environ.get("BENCH_SYNC_MB", "2")),
+                int(os.environ.get("BENCH_SYNC_HOSTS", "2")),
+                int(os.environ.get("BENCH_SYNC_LOCAL", "8")))
+            s = details["host_sync"]
+            hb, yb = s["host_backend"], s["hybrid_backend"]
+            print(f"[bench] host sync {s['payload_mb']}MB x"
+                  f"{s['hosts']}hx{s['local_devices']}d: flat "
+                  f"{hb['host_leg_bytes_per_host']/1e6:.1f} MB/host -> "
+                  f"hybrid {yb['host_leg_bytes_per_host']/1e6:.1f} MB/host "
+                  f"({s['host_leg_byte_reduction']:.1f}x fewer); emulated "
+                  f"{s['emulated_link_mb_s']:.0f} MB/s link: "
+                  f"{hb['syncs_per_sec_emulated']:.2f} -> "
+                  f"{yb['syncs_per_sec_emulated']:.2f} syncs/s "
+                  f"({s['hybrid_sync_speedup_emulated']:.1f}x)",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] host sync bench failed: {e}", file=sys.stderr)
 
     # --- host wire path: per-leaf vs packed/quantized frames -----------------
     if os.environ.get("BENCH_SKIP_WIRE") != "1":
@@ -2739,6 +2916,27 @@ if __name__ == "__main__":
         with open(path, "w") as fh:
             json.dump(details, fh, indent=2)
         print(json.dumps(w))
+    elif "--host-sync-probe" in sys.argv:
+        # Standalone collective-backend probe: runs host_sync_bench
+        # alone and MERGES the row into BENCH_DETAILS.json (read-
+        # modify-write) so a backend re-measure doesn't discard the
+        # training rows.  TPU-free: the hybrid children force the
+        # 8-device CPU platform themselves.
+        hs = host_sync_bench(
+            int(os.environ.get("BENCH_SYNC_MB", "2")),
+            int(os.environ.get("BENCH_SYNC_HOSTS", "2")),
+            int(os.environ.get("BENCH_SYNC_LOCAL", "8")))
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_DETAILS.json")
+        try:
+            with open(path) as fh:
+                details = json.load(fh)
+        except (OSError, ValueError):
+            details = {}
+        details["host_sync"] = hs
+        with open(path, "w") as fh:
+            json.dump(details, fh, indent=2)
+        print(json.dumps(hs))
     elif "--multichip-probe" in sys.argv:
         _pin_cpu(int(os.environ.get("BENCH_MC_DEVICES", "8")))
         _enable_compile_cache()

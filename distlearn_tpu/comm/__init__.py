@@ -2,13 +2,21 @@
 the torch-ipc replacement (SURVEY.md §2b row 1).  The TPU data plane uses XLA
 ICI collectives (distlearn_tpu.parallel.mesh); this package is the control
 plane for the asynchronous parameter-server path and multi-host side-channel.
+:mod:`distlearn_tpu.comm.backend` unifies the two behind one
+:class:`CollectiveBackend` protocol (host TCP, device SPMD, or the hybrid
+hierarchical allreduce).
 """
 
 from distlearn_tpu.comm import wire
+from distlearn_tpu.comm.backend import (CollectiveBackend, HostBackend,
+                                        HybridBackend, MeshBackend)
 from distlearn_tpu.comm.errors import PeerClosed
 from distlearn_tpu.comm.faults import FaultInjected, FaultPlan
 from distlearn_tpu.comm.transport import Conn, Server, connect, ProtocolError
 from distlearn_tpu.comm.ring import LocalhostRing, Ring
+from distlearn_tpu.comm.tree import LocalhostTree, Tree, tree_map_spawn
 
 __all__ = ["Conn", "Server", "connect", "PeerClosed", "ProtocolError", "Ring",
-           "LocalhostRing", "wire", "FaultPlan", "FaultInjected"]
+           "LocalhostRing", "Tree", "LocalhostTree", "tree_map_spawn",
+           "wire", "FaultPlan", "FaultInjected", "CollectiveBackend",
+           "HostBackend", "MeshBackend", "HybridBackend"]

@@ -51,7 +51,7 @@ except Exception:  # pragma: no cover
     _jtu = None
 
 from distlearn_tpu.comm import native
-from distlearn_tpu.comm.tree import _identity
+from distlearn_tpu.comm.backend import HostCollectiveBase, _identity
 from distlearn_tpu.comm.transport import Conn, Server, connect
 
 PyTree = Any
@@ -117,7 +117,7 @@ class _Sender:
         self._t.join(timeout=5.0)
 
 
-class Ring:
+class Ring(HostCollectiveBase):
     """One rank's handle on the ring (construct one per process/thread).
 
     Same constructor contract as :class:`distlearn_tpu.comm.tree.Tree`:
@@ -136,7 +136,8 @@ class Ring:
                  timeout: float = 60.0,
                  listen_host: str | None = None,
                  advertise_host: str | None = None,
-                 op_timeout: float | None = None):
+                 op_timeout: float | None = None,
+                 fault_plan=None, fault_link: str = "ring"):
         if not 0 <= rank < num_nodes:
             raise ValueError(f"rank {rank} out of range for {num_nodes} nodes")
         self.rank = rank
@@ -193,39 +194,30 @@ class Ring:
                 f"{hello['pred']}, expected {expect}")
         pred_server.conns.clear()   # detach _pred: close only the listener
         pred_server.close()
+        if fault_plan is not None:
+            self._pred = fault_plan.wrap(self._pred, fault_link)
+            self._succ = fault_plan.wrap(self._succ, fault_link)
         self._sender = _Sender(self._succ)
         self.set_op_timeout(op_timeout)
 
-    def set_op_timeout(self, seconds: float | None):
-        self.op_timeout = seconds
-        for conn in (self._pred, self._succ):
-            if conn is not None:
-                conn.set_timeout(seconds)
-
-    # -- walkTable parity ----------------------------------------------------
-    @staticmethod
-    def walk(tree: PyTree, fn: Callable) -> PyTree:
-        return _jtu.tree_map(fn, tree)
-
-    @property
-    def node_index(self) -> int:
-        return self.rank
+    def _links(self) -> list[Conn]:
+        return [c for c in (self._pred, self._succ) if c is not None]
 
     # -- collectives ---------------------------------------------------------
-    def all_reduce(self, value: PyTree, op: str = "sum",
-                   contrib: bool = True) -> tuple[PyTree, int]:
-        """Ring allreduce; returns ``(reduced, n_contributors)``.  Same
-        contributor semantics as the tree backend (zero-contribution flush,
-        lua/AllReduceSGD.lua:37)."""
-        reduced, n, _ = self.all_reduce_ex(value, op=op, contrib=contrib)
-        return reduced, n
-
     def all_reduce_ex(self, value: PyTree, op: str = "sum",
-                      contrib: bool = True, rider: int = 0
-                      ) -> tuple[PyTree, int, int]:
+                      contrib: bool = True, rider: int = 0,
+                      codec: str = "raw") -> tuple[PyTree, int, int]:
         """:meth:`all_reduce` plus the out-of-band integer ``rider`` summed
         across ALL ranks regardless of ``contrib`` (round metadata for the
-        uneven-step protocol — see Tree.all_reduce_ex)."""
+        uneven-step protocol — see Tree.all_reduce_ex).
+
+        The ring's chunked per-tensor frames have nowhere to carry a
+        quantization scale, so only ``codec="raw"`` is supported (the
+        tree host leg carries the lossy codecs)."""
+        if codec != "raw":
+            raise ValueError(
+                f"Ring.all_reduce_ex is raw-only (got codec={codec!r}); "
+                "use the tree transport for lossy host legs")
         leaves = [np.ascontiguousarray(np.asarray(x))
                   for x in _jtu.tree_leaves(value)]
         if not contrib:
@@ -321,9 +313,6 @@ class Ring:
                 self._sender.flush()
         treedef = _jtu.tree_structure(value)
         return _jtu.tree_unflatten(treedef, out)
-
-    def barrier(self):
-        self.all_reduce(np.zeros((), np.int32))
 
     def close(self):
         if self._sender is not None:

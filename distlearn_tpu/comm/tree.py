@@ -7,8 +7,10 @@ Role in the TPU framework: the **DCN/host side-channel**.  On-chip
 collectives go through XLA/ICI (distlearn_tpu.parallel.mesh); this tree
 carries host-side traffic that must cross processes or hosts outside a jitted
 program — multi-host bootstrap, control-plane reductions, metric aggregation
-for processes not sharing a mesh.  The byte-moving and reduction inner loops
-run in native C++ (distcomm framing + elementwise kernels).
+for processes not sharing a mesh, and the per-host leg of the hybrid
+hierarchical allreduce (distlearn_tpu.comm.backend.HybridBackend).  The
+byte-moving and reduction inner loops run in native C++ (distcomm framing +
+elementwise kernels).
 
 Topology: complete base-``b`` tree over 0-based ranks in level order —
 ``parent(i) = (i-1)//b``, ``children(i) = i*b+1 .. i*b+b``.  Bootstrap: every
@@ -19,12 +21,14 @@ root).
 API parity with the reference ``tree`` handle: ``all_reduce`` (+ contributor
 count and zero-contribution flush semantics — lua/AllReduceSGD.lua:12,37),
 ``scatter`` (root broadcast), ``walk`` (walkTable), ``node_index``,
-``num_nodes``.
+``num_nodes``.  The topology-independent pieces (walk, node_index, op-timeout
+arming, the ``all_reduce``/``barrier`` derivations, NIC accounting) live on
+the shared :class:`~distlearn_tpu.comm.backend.HostCollectiveBase` so the
+ring and any future host topology reuse one surface.
 """
 
 from __future__ import annotations
 
-import socket
 import threading
 import time
 from typing import Any, Callable
@@ -37,22 +41,10 @@ except Exception:  # pragma: no cover
     _jtu = None
 
 from distlearn_tpu.comm import native
+from distlearn_tpu.comm.backend import HostCollectiveBase, _identity  # noqa: F401 — _identity re-exported for compat
 from distlearn_tpu.comm.transport import Conn, Server, connect
 
 PyTree = Any
-
-
-def _identity(dtype: np.dtype, op: str):
-    """Reduction identity for a non-contributing rank's slot."""
-    if op == "sum":
-        return 0
-    if op == "max":
-        return -np.inf if np.issubdtype(dtype, np.floating) \
-            else np.iinfo(dtype).min
-    if op == "min":
-        return np.inf if np.issubdtype(dtype, np.floating) \
-            else np.iinfo(dtype).max
-    raise ValueError(f"unknown op {op!r}")
 
 
 def _parent(rank: int, base: int) -> int:
@@ -64,7 +56,7 @@ def _children(rank: int, base: int, n: int) -> list[int]:
             if c < n]
 
 
-class Tree:
+class Tree(HostCollectiveBase):
     """One rank's handle on the tree (construct one per process/thread).
 
     ``rank`` is 0-based (the reference's nodeIndex is 1-based; the examples
@@ -76,7 +68,8 @@ class Tree:
                  base: int = 2, timeout: float = 60.0,
                  listen_host: str | None = None,
                  advertise_host: str | None = None,
-                 op_timeout: float | None = None):
+                 op_timeout: float | None = None,
+                 fault_plan=None, fault_link: str = "tree"):
         """``host``/``port``: the coordinator (rank 0) address every rank
         dials for bootstrap.  Multi-host ranks must also say where THEY can
         be reached: ``listen_host`` is the local bind address for this rank's
@@ -92,7 +85,15 @@ class Tree:
         waits longer than this many seconds on one peer raises
         :class:`TimeoutError` instead of wedging the job.  ``None`` keeps
         the reference's block-forever semantics (collectives may
-        legitimately wait on slow ranks)."""
+        legitimately wait on slow ranks).
+
+        ``fault_plan``: optional :class:`~distlearn_tpu.comm.faults.
+        FaultPlan`; every data-plane link (parent + children) is wrapped
+        onto ``fault_link`` after bootstrap, so injected partitions/delays
+        hit the collectives with the handle's normal error semantics
+        (``op_timeout`` → :class:`TimeoutError`) — the same surface whether
+        the tree is used raw or behind a
+        :class:`~distlearn_tpu.comm.backend.HybridBackend` host leg."""
         if not 0 <= rank < num_nodes:
             raise ValueError(f"rank {rank} out of range for {num_nodes} nodes")
         if base < 1:
@@ -102,6 +103,8 @@ class Tree:
         self.base = base
         self._kids: list[Conn] = []
         self._parent: Conn | None = None
+        self._codec_fb = None
+        self._codec_scratch: list[np.ndarray] | None = None
         kid_ranks = _children(rank, base, num_nodes)
 
         bind_host = listen_host if listen_host is not None else host
@@ -149,44 +152,56 @@ class Tree:
                 hello = conn.recv_msg()
                 by_rank[int(hello["child"])] = conn
             self._kids = [by_rank[r] for r in sorted(by_rank)]
+        if fault_plan is not None:
+            if self._parent is not None:
+                self._parent = fault_plan.wrap(self._parent, fault_link)
+            self._kids = [fault_plan.wrap(k, fault_link) for k in self._kids]
         self.set_op_timeout(op_timeout)
 
-    def set_op_timeout(self, seconds: float | None):
-        """(Re)arm failure detection on every tree link (see ``op_timeout``)."""
-        self.op_timeout = seconds
-        for conn in ([self._parent] if self._parent else []) + self._kids:
-            conn.set_timeout(seconds)
-
-    # -- walkTable parity ----------------------------------------------------
-    @staticmethod
-    def walk(tree: PyTree, fn: Callable) -> PyTree:
-        return _jtu.tree_map(fn, tree)
-
-    @property
-    def node_index(self) -> int:
-        return self.rank
+    def _links(self) -> list[Conn]:
+        return ([self._parent] if self._parent else []) + self._kids
 
     # -- collectives ---------------------------------------------------------
-    def all_reduce(self, value: PyTree, op: str = "sum",
-                   contrib: bool = True) -> tuple[PyTree, int]:
-        """Tree allreduce; returns ``(reduced, n_contributors)``.
-
-        ``contrib=False`` reproduces the reference's zero-contribution flush
-        (lua/AllReduceSGD.lua:37): this rank's values count as zeros and it
-        is excluded from ``n`` — but it still serves the reduction for the
-        rest of the tree, which is exactly how stopped nodes keep stragglers'
-        reductions alive in the reference.
-        """
-        reduced, n, _ = self.all_reduce_ex(value, op=op, contrib=contrib)
-        return reduced, n
+    def _send_reduced(self, conn: Conn, leaves: list[np.ndarray], codec: str):
+        """Ship a reduced leaf list one hop.  ``raw`` is the exact path;
+        lossy codecs quantize per hop (no cross-round error carry — the
+        residual the fused kernel produces is scratch here), through the
+        fused encode-into-FrameBuffer kernels when built so steady state
+        allocates nothing and the frame leaves as one iovec."""
+        if codec == "raw":
+            conn.send_tensors(leaves)
+            return
+        from distlearn_tpu.ops import wire_kernels
+        if wire_kernels.wirek_enabled():
+            from distlearn_tpu.comm import wire
+            if self._codec_fb is None:
+                self._codec_fb = wire.FrameBuffer()
+            if (self._codec_scratch is None
+                    or len(self._codec_scratch) != len(leaves)
+                    or any(s.shape != a.shape or s.dtype != a.dtype
+                           for s, a in zip(self._codec_scratch, leaves))):
+                self._codec_scratch = [np.zeros(a.shape, a.dtype)
+                                       for a in leaves]
+            else:
+                for s in self._codec_scratch:
+                    s[...] = 0      # one-hop quantize: no residual carry
+            payload = wire_kernels.encode_ef_into(
+                leaves, self._codec_scratch, codec, out=self._codec_fb)
+            conn.send_packed(payload)
+        else:
+            conn.send_tensors(leaves, codec=codec)
 
     def all_reduce_ex(self, value: PyTree, op: str = "sum",
-                      contrib: bool = True, rider: int = 0
-                      ) -> tuple[PyTree, int, int]:
+                      contrib: bool = True, rider: int = 0,
+                      codec: str = "raw") -> tuple[PyTree, int, int]:
         """:meth:`all_reduce` plus an out-of-band integer ``rider`` summed
         across ALL ranks regardless of ``contrib`` — carries round metadata
         (e.g. how many participants are in flush mode, the uneven-step
-        protocol of distlearn_tpu.parallel.host_algorithms)."""
+        protocol of distlearn_tpu.parallel.host_algorithms).
+
+        ``codec``: wire codec per hop (``raw``/``fp16``/``int8``).  Float
+        leaves quantize on every link they cross under a lossy codec —
+        bandwidth for accuracy, the HybridBackend host-leg knob."""
         leaves = [np.ascontiguousarray(np.asarray(x))
                   for x in _jtu.tree_leaves(value)]
         if not contrib:
@@ -218,7 +233,7 @@ class Tree:
         # Send to parent; receive final result down.
         if self._parent is not None:
             self._parent.send_msg({"n": n, "r": r})
-            self._parent.send_tensors(acc)
+            self._send_reduced(self._parent, acc, codec)
             down = self._parent.recv_msg()
             total, r_total = int(down["n"]), int(down["r"])
             final = self._parent.recv_tensors(out=acc)
@@ -227,7 +242,7 @@ class Tree:
         # Down phase: forward result to children.
         for kid in self._kids:
             kid.send_msg({"n": total, "r": r_total})
-            kid.send_tensors(final)
+            self._send_reduced(kid, final, codec)
         treedef = _jtu.tree_structure(value)
         return _jtu.tree_unflatten(treedef, final), total, r_total
 
@@ -248,10 +263,6 @@ class Tree:
             kid.send_tensors(leaves)
         treedef = _jtu.tree_structure(value)
         return _jtu.tree_unflatten(treedef, leaves)
-
-    def barrier(self):
-        """All ranks rendezvous (reduce of a scalar)."""
-        self.all_reduce(np.zeros((), np.int32))
 
     def close(self):
         if self._parent:

@@ -78,7 +78,8 @@ from distlearn_tpu.lint.core import Finding
 __all__ = [
     "ModelSpec", "ModelReport", "check_model", "builtin_models",
     "sync_model", "sharded_model", "replay_model", "failover_model",
-    "serve_model", "membership_model", "router_model", "lint_models",
+    "serve_model", "membership_model", "router_model",
+    "backend_sync_model", "lint_models",
 ]
 
 State = Hashable
@@ -350,6 +351,54 @@ def sharded_model(*, server_timeouts: bool = True) -> ModelSpec:
     return _script_model(
         "sharded", scripts, groups, crashable=("client",),
         timeout_ranks=("S0", "S1") if server_timeouts else ())
+
+
+def backend_sync_model(*, backend: str = "host",
+                       host_timeouts: bool = True) -> ModelSpec:
+    """One collective round of a :mod:`distlearn_tpu.comm.backend`
+    topology under process faults.
+
+    ``backend="host"``: a base-2 TCP tree root with two kid processes —
+    each kid sends its up-phase payload and blocks for the down-phase
+    result; the root folds both kids then fans the result back (the
+    Tree.all_reduce_ex schedule, one message per phase per link).
+
+    ``backend="hybrid"``: two hosts, each a process with a device-stage
+    rank (the in-mesh reduce-scatter/all-gather + D2H/H2D staging,
+    modeled as in-process messages that cannot time out) and a host-leg
+    rank running the one-TCP-leg-per-host reduction.
+
+    ``host_timeouts`` models ``op_timeout``-armed TCP recvs; with it
+    mutated off, a hung peer wedges the collective forever — DL301, the
+    reference's documented failure mode (SURVEY.md §5)."""
+    if backend == "host":
+        scripts = {
+            "R": [_rcv("K1", "up"), _rcv("K2", "up"),
+                  _snd("K1", "down"), _snd("K2", "down")],
+            "K1": [_snd("R", "up"), _rcv("R", "down")],
+            "K2": [_snd("R", "up"), _rcv("R", "down")],
+        }
+        groups = {"R": "root", "K1": "kid1", "K2": "kid2"}
+        return _script_model(
+            f"backend_sync[{backend}]", scripts, groups,
+            crashable=("kid2",),
+            timeout_ranks=("R", "K1", "K2") if host_timeouts else ())
+    if backend == "hybrid":
+        scripts = {
+            "D0": [_snd("H0", "shards"), _rcv("H0", "reduced")],
+            "H0": [_rcv("D0", "shards"), _rcv("H1", "up"),
+                   _snd("H1", "down"), _snd("D0", "reduced")],
+            "D1": [_snd("H1", "shards"), _rcv("H1", "reduced")],
+            "H1": [_rcv("D1", "shards"), _snd("H0", "up"),
+                   _rcv("H0", "down"), _snd("D1", "reduced")],
+        }
+        groups = {"D0": "host0", "H0": "host0",
+                  "D1": "host1", "H1": "host1"}
+        return _script_model(
+            f"backend_sync[{backend}]", scripts, groups,
+            crashable=("host1",),
+            timeout_ranks=("H0", "H1") if host_timeouts else ())
+    raise ValueError(f"unknown backend {backend!r} (host or hybrid)")
 
 
 # ---------------------------------------------------------------------------
@@ -1013,7 +1062,8 @@ def builtin_models() -> list[ModelSpec]:
     """The shipped models in their faithful (unmutated) configuration."""
     return [sync_model(), sharded_model(), replay_model(),
             failover_model(), serve_model(), membership_model(),
-            router_model()]
+            router_model(), backend_sync_model(backend="host"),
+            backend_sync_model(backend="hybrid")]
 
 
 def lint_models() -> "list[tuple[ModelReport, ModelSpec]]":

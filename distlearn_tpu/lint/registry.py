@@ -336,6 +336,36 @@ def _wirek_family():
     return _lint_units(units, None)
 
 
+def _sync_family():
+    """The sync collectives themselves: the MeshBackend allreduce
+    programs (plain + contrib-masked) and the two device-side phases of
+    the HybridBackend hierarchical allreduce (in-mesh reduce-scatter,
+    post-host-leg all-gather) — so the DL2xx cost budgets cover
+    cross-node sync, not just the train steps that call it
+    (comm/backend.py, lint/budgets/sync.json)."""
+    import jax
+    from distlearn_tpu.comm.backend import HybridBackend, MeshBackend
+    mb = MeshBackend(num_nodes=8)
+    # representative mixed payload: a matrix + a bias per node row
+    val = {"b": jax.ShapeDtypeStruct((8, 64), "float32"),
+           "w": jax.ShapeDtypeStruct((8, 128, 64), "float32")}
+    cvec = jax.ShapeDtypeStruct((8,), "int32")
+    hb = HybridBackend(0, 1, num_devices=8)
+    plan = hb._plan(val)
+    rs, ag = hb._programs(*plan)
+    chunks = tuple(jax.ShapeDtypeStruct((padded,), dt.name)
+                   for dt, _idxs, _total, padded, _chunks in plan[5])
+    units = [
+        ("sync_mesh_allreduce",
+         mb.mesh_tree.all_reduce_program(False), (val,)),
+        ("sync_mesh_allreduce_masked",
+         mb.mesh_tree.all_reduce_program(True), (val, cvec)),
+        ("sync_hybrid_reduce_scatter", rs, (val, cvec)),
+        ("sync_hybrid_all_gather", ag, chunks),
+    ]
+    return _lint_units(units, mb.mesh)
+
+
 def _protocol_family():
     from distlearn_tpu.lint.protocol import (async_ea_sync_schedule,
                                              check_schedules,
@@ -406,6 +436,9 @@ _FAMILIES = {
     "wirek": Entry("wirek",
                    "fused wire-codec kernels (int8 quantize+EF / "
                    "dequantize+apply / amax)", _wirek_family),
+    "sync": Entry("sync",
+                  "collective-backend sync programs (mesh allreduce + "
+                  "hybrid reduce-scatter/all-gather)", _sync_family),
     "protocol": Entry("protocol",
                       "host comm schedules (tree/ring/AsyncEA) + lock audit",
                       _protocol_family),

@@ -1,0 +1,69 @@
+"""D2H staging: device shard-sums -> one contiguous host frame.
+
+The hybrid hierarchical allreduce (:class:`distlearn_tpu.comm.backend.
+HybridBackend`) ends its in-mesh reduce-scatter with each local device
+holding a distinct flat shard-sum.  Before the host TCP leg those shards
+must become ONE contiguous host vector per dtype group — the buffer the
+tree/ring reduction folds into and :meth:`Conn.send_packed` ships as a
+single iovec.  :func:`stage_into` does that hop with the same
+no-per-sync-allocation discipline as the wire codec kernels: the
+destination is a reusable :class:`~distlearn_tpu.comm.wire.FrameBuffer`
+grown once to the round's wire size, each device shard copies straight
+into its typed window (``np.copyto`` of a device array's host view —
+on CPU meshes effectively a memcpy, on TPU the D2H transfer), and the
+returned views alias the frame, so the host leg reduces in place with
+zero gather copies.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def stage_into(fb, arrays: Sequence, dtypes: Sequence[np.dtype]
+               ) -> list[np.ndarray]:
+    """Stage flat device arrays into ``fb``; return per-array host views.
+
+    Args:
+      fb: a :class:`~distlearn_tpu.comm.wire.FrameBuffer`; reserved
+        (grow-never-shrink) to the total byte size, then each array's
+        addressable shards copy into a typed window at its offset.
+      arrays: flat (1-D) global jax.Arrays — e.g. one reduce-scattered
+        vector per dtype group, sharded along their only axis.  Every
+        shard this process addresses lands at its global index; with a
+        fully-addressable mesh (single process) the views come back
+        complete.
+      dtypes: target dtype per array (the wire dtype of its group).
+
+    Returns:
+      One writable 1-D ``np.ndarray`` view per input, all aliasing
+      ``fb.buf`` back-to-back — mutating them (e.g. the tree reduction's
+      ``reduce_inplace``) mutates the frame that ships.
+    """
+    if len(arrays) != len(dtypes):
+        raise ValueError(f"{len(arrays)} arrays vs {len(dtypes)} dtypes")
+    dtypes = [np.dtype(dt) for dt in dtypes]
+    sizes, offsets, total = [], [], 0
+    for arr, dt in zip(arrays, dtypes):
+        if len(arr.shape) != 1:
+            raise ValueError(f"stage_into takes flat vectors, got shape "
+                             f"{tuple(arr.shape)}")
+        total += (-total) % 16  # keep every typed window 16B-aligned
+        offsets.append(total)
+        sizes.append(int(arr.shape[0]))
+        total += sizes[-1] * dt.itemsize
+    fb.reserve(total)
+    views = []
+    for arr, dt, off, size in zip(arrays, dtypes, offsets, sizes):
+        dst = fb.view(off, size * dt.itemsize, dt, (size,))
+        shards = getattr(arr, "addressable_shards", None)
+        if shards is None:  # plain host array (tests / degenerate paths)
+            np.copyto(dst, np.asarray(arr), casting="same_kind")
+        else:
+            for sh in shards:
+                np.copyto(dst[sh.index], np.asarray(sh.data),
+                          casting="same_kind")
+        views.append(dst)
+    return views

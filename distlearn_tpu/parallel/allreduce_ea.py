@@ -139,25 +139,47 @@ def synchronize_parameters(params: PyTree, state: EAState,
 # ---------------------------------------------------------------------------
 
 class AllReduceEA:
-    """Host-level API over stacked node arrays, mirroring the reference
-    closures.  The center lives on device as a stacked node array; per-node
-    step counts are host-side (the host drives round cadence, ref lua :5,31).
-    Every elastic round is one jitted shard_map over the mesh.
+    """Host-level API mirroring the reference closures, over any
+    :class:`~distlearn_tpu.comm.backend.CollectiveBackend`.
+
+    On a whole-view handle (:class:`MeshTree`/``MeshBackend``) the center
+    lives on device as a stacked node array and every elastic round is one
+    jitted shard_map over the mesh (the fused fast path).  On a partial-view
+    handle (``HostBackend``: one node per process; ``HybridBackend``: this
+    host's slice) the round is the generic delta/allreduce/center-move over
+    the protocol — and, like the reference (lua :31: a due node *blocks* in
+    ``tree.allReduce`` until every peer reaches its own next call), rounds
+    pair up by ordinal across handles: every process must hit its ``tau``
+    boundaries on the same calls (uniform stepping), or drive the full
+    uneven-step flush protocol of
+    :mod:`distlearn_tpu.parallel.host_algorithms` instead.
+
+    Per-node step counts are host-side (the host drives round cadence,
+    ref lua :5,31).
     """
 
     def __init__(self, tree: MeshTree, tau: int, alpha: float):
         self.tree = tree
         self.tau = int(tau)
         self.alpha = float(alpha)
-        self._axis = tree.axis_name
-        self._center = None     # stacked node array pytree
+        self._axis = getattr(tree, "axis_name", None)
+        stacked = getattr(tree, "stacked_nodes", tree.num_nodes)
+        self._local = 1 if stacked is None else int(stacked)
+        self._offset = int(getattr(tree, "node_offset", 0))
+        self._fused = (self._local == tree.num_nodes
+                       and hasattr(tree, "spmd"))
+        self._center = None     # pytree, handle's value convention
         self._steps = None      # host-side per-node counts (ref lua :5)
         self._round_jit = None
 
     def _one_time_init(self, params: PyTree):
         """Ref ``oneTimeInit`` (lua :11-22): clone params as the center."""
         if self._center is None:
-            self._center = jax.tree_util.tree_map(jnp.array, params)
+            if self._fused:
+                self._center = jax.tree_util.tree_map(jnp.array, params)
+            else:
+                self._center = jax.tree_util.tree_map(
+                    lambda p: np.array(np.asarray(p), copy=True), params)
             self._steps = np.zeros(self.tree.num_nodes, dtype=np.int64)
 
     def _round(self, params, center):
@@ -178,18 +200,48 @@ class AllReduceEA:
                 out_specs=(self.tree.node_spec(), self.tree.node_spec()))
         return self._round_jit(params, center)
 
+    def _round_generic(self, params: PyTree) -> PyTree:
+        """One full-participation elastic round over the protocol (host /
+        hybrid handles): host-side delta math, one backend allreduce.
+        Same three assignments as :func:`elastic_round`, so with
+        order-insensitive (dyadic-exact) arithmetic the trajectory is
+        bitwise the fused mesh path's."""
+        a = self.alpha
+
+        def _delta(p, c):
+            p = np.asarray(p)
+            return (p - np.asarray(c)) * np.asarray(a, p.dtype)
+
+        delta = jax.tree_util.tree_map(_delta, params, self._center)
+        new_params = jax.tree_util.tree_map(
+            lambda p, d: np.asarray(p) - d, params, delta)
+        sum_d, _ = self.tree.all_reduce(delta)
+        self._center = jax.tree_util.tree_map(
+            lambda c, d: np.asarray(c) + np.asarray(d),
+            self._center, sum_d)
+        return new_params
+
     def average_parameters(self, params: PyTree, contrib=None) -> PyTree:
         """Ref lua :25-47: bump local steps; when any node's count hits a tau
-        boundary, run the full-participation elastic round."""
+        boundary, run the full-participation elastic round.  On a
+        partial-view handle the due-check sees only this handle's nodes
+        (the reference's ordinal pairing — class docstring)."""
         self._one_time_init(params)
-        c = np.ones(self.tree.num_nodes, dtype=np.int64) if contrib is None \
-            else np.asarray(contrib, dtype=np.int64)
-        self._steps += c
-        due = (c > 0) & (self._steps % self.tau == 0)
+        lo, hi = self._offset, self._offset + self._local
+        if contrib is None or contrib is True:
+            c = np.ones(self._local, dtype=np.int64)
+        elif contrib is False:
+            c = np.zeros(self._local, dtype=np.int64)
+        else:
+            c = np.asarray(contrib, dtype=np.int64)
+        self._steps[lo:hi] += c
+        due = (c > 0) & (self._steps[lo:hi] % self.tau == 0)
         if not due.any():
             return params
-        new_params, self._center = self._round(params, self._center)
-        return new_params
+        if self._fused:
+            new_params, self._center = self._round(params, self._center)
+            return new_params
+        return self._round_generic(params)
 
     def synchronize_center(self, params: PyTree) -> PyTree:
         """Ref lua :77-84: scatter(center) drift repair + step reset (the
@@ -204,6 +256,10 @@ class AllReduceEA:
         if self._steps is None:
             self._steps = np.zeros(self.tree.num_nodes, dtype=np.int64)
         params = self.tree.scatter(params, src=0)
-        self._center = jax.tree_util.tree_map(jnp.array, params)
+        if self._fused:
+            self._center = jax.tree_util.tree_map(jnp.array, params)
+        else:
+            self._center = jax.tree_util.tree_map(
+                lambda p: np.array(np.asarray(p), copy=True), params)
         self._steps[:] = 0
         return params

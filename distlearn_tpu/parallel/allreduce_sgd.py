@@ -115,21 +115,37 @@ def synchronize_parameters(params: PyTree, state: SGDSyncState,
 # ---------------------------------------------------------------------------
 
 class AllReduceSGD:
-    """Factory over a :class:`MeshTree`, mirroring ``AllReduceSGD(tree)``
-    (lua :4): host-level methods operate on stacked node arrays (leading
-    ``num_nodes`` axis).  Training loops that care about throughput should
-    instead compose the in-step functions above into one jitted train step —
-    see :mod:`distlearn_tpu.train.trainer`.
+    """Factory over any :class:`~distlearn_tpu.comm.backend.
+    CollectiveBackend`, mirroring ``AllReduceSGD(tree)`` (lua :4).
+
+    ``tree`` may be a :class:`MeshTree`/``MeshBackend`` (whole-view:
+    stacked node arrays, this handle sees every node), a ``HostBackend``
+    (one node per process, plain pytrees), or a ``HybridBackend`` (this
+    host's ``stacked_nodes``-row slice of the global node set).  The
+    value convention follows the handle (module docstring of
+    distlearn_tpu.comm.backend); ``contrib`` follows it too — a
+    per-node vector for whole-view handles, a bool or per-local-row
+    mask otherwise.  Training loops that care about throughput should
+    instead compose the in-step functions above into one jitted train
+    step — see :mod:`distlearn_tpu.train.trainer`.
     """
 
     def __init__(self, tree: MeshTree):
         self.tree = tree
-        self._axis = tree.axis_name
-        # steps per node, host-tracked (ref keeps a LongTensor, lua :7).
+        self._axis = getattr(tree, "axis_name", None)
+        stacked = getattr(tree, "stacked_nodes", tree.num_nodes)
+        self._local = 1 if stacked is None else int(stacked)
+        self._offset = int(getattr(tree, "node_offset", 0))
+        self._whole = self._local == tree.num_nodes
+        # steps per node, host-tracked (ref keeps a LongTensor, lua :7);
+        # partial-view handles fill only their own slots and allreduce the
+        # vector at sync time — exactly the reference's lazy
+        # ``stepsPerNode`` (lua :13-14,:39).
         self._steps = np.zeros(tree.num_nodes, dtype=np.int64)
 
     def sum_gradients(self, grads: PyTree, contrib=None) -> tuple[PyTree, int]:
-        """Ref lua :10-15. ``grads``: stacked node arrays. Returns (summed, n)."""
+        """Ref lua :10-15. ``grads`` follow the handle's value convention.
+        Returns (summed, n)."""
         out, n = self.tree.all_reduce(grads, contrib=contrib)
         self._bump(contrib)
         return out, n
@@ -144,16 +160,40 @@ class AllReduceSGD:
         return out, n
 
     def _bump(self, contrib):
-        if contrib is None:
-            self._steps += 1
+        lo, hi = self._offset, self._offset + self._local
+        if contrib is None or contrib is True:
+            self._steps[lo:hi] += 1
+        elif contrib is False:
+            pass
         else:
-            self._steps += np.asarray(contrib, dtype=np.int64)
+            self._steps[lo:hi] += np.asarray(contrib, dtype=np.int64)
+
+    def _global_steps(self) -> np.ndarray:
+        """Every handle's view of the full per-node step vector.  Whole-view
+        handles already hold it; partial-view handles allreduce a vector
+        carrying only their own slots (slots are disjoint, so the sum IS
+        the global vector — the reference's sync-time allreduce of
+        ``stepsPerNode``, lua :39)."""
+        if self._whole:
+            return self._steps
+        mine = np.zeros(self.tree.num_nodes, np.int64)
+        lo, hi = self._offset, self._offset + self._local
+        mine[lo:hi] = self._steps[lo:hi]
+        if getattr(self.tree, "stacked_nodes", None) is None:
+            red, _ = self.tree.all_reduce(mine)
+            return np.asarray(red)
+        stacked = np.zeros((self._local, self.tree.num_nodes), np.int64)
+        for r in range(self._local):
+            stacked[r, lo + r] = self._steps[lo + r]
+        red, _ = self.tree.all_reduce(stacked)
+        return np.asarray(self.tree.node_slice(red, 0))
 
     def synchronize_parameters(self, params: PyTree) -> PyTree:
         """Ref lua :33-54: winner-takes-all (most steps, ties → highest index),
         or plain scatter from root when no node stepped this epoch."""
-        if self._steps.max() > 0:
-            winner = int(len(self._steps) - 1 - np.argmax(self._steps[::-1]))
+        steps = self._global_steps()
+        if steps.max() > 0:
+            winner = int(len(steps) - 1 - np.argmax(steps[::-1]))
             synced = self.tree.scatter(params, src=winner)
         else:
             synced = self.tree.scatter(params, src=0)

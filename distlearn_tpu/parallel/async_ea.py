@@ -2710,6 +2710,7 @@ class AsyncEAClient:
                  throttle_bps: float | None = None,
                  centers: list[tuple[str, int]] | None = None,
                  capacity: float = 1.0, adaptive_tau: bool = False,
+                 slice_backend=None,
                  _broadcast: Conn | None = None,
                  _dedicated_port: int | None = None):
         if node < 1:
@@ -2753,6 +2754,21 @@ class AsyncEAClient:
         if throttle_bps:
             self.conn.throttle_bps = throttle_bps
         self.center: list[np.ndarray] | None = None
+        # the "client is a whole pod slice" deployment (ROADMAP item 1):
+        # a stacked-value backend (MeshBackend / single-host HybridBackend)
+        # reducing this client's L device rows; params carry a leading
+        # [L] axis, the center stays wire-shape, and ONE TCP leg pushes
+        # the slice-sum delta — equivalent to L plain clients syncing
+        # against the same center snapshot, at 1/L the host-leg bytes
+        self._slice = slice_backend
+        self._slice_rows = 0
+        if slice_backend is not None:
+            rows = getattr(slice_backend, "stacked_nodes", None)
+            if not rows:
+                raise ValueError(
+                    "slice_backend must be a stacked-value backend "
+                    "(stacked_nodes set) — MeshBackend or HybridBackend")
+            self._slice_rows = int(rows)
         # None until the first handshake; False pins legacy once a plain-
         # string reply proves the server predates the packed wire
         self._packed: bool | None = None
@@ -2947,6 +2963,13 @@ class AsyncEAClient:
         either framing."""
         leaves = _leaves(params)
         self.center = self.broadcast.recv_tensors(n=len(leaves))
+        if self._slice is not None:
+            # every device row of the slice starts at the center
+            L = self._slice_rows
+            return _rebuild(params, [
+                np.ascontiguousarray(
+                    np.broadcast_to(c[None], (L,) + c.shape))
+                for c in self.center])
         return _rebuild(params, [c.copy() for c in self.center])
 
     def sync_client(self, params: PyTree) -> tuple[PyTree, bool]:
@@ -3019,16 +3042,32 @@ class AsyncEAClient:
         # scale a second full-size allocation per leaf is measurable on the
         # sync path.
         leaves = _leaves(params)
-        deltas = []
-        for p, c in zip(leaves, self.center):
-            # deltas go over the wire in the CENTER's dtype: the server
-            # rejects dtype skew as config skew, and a client whose local
-            # params drifted wider (e.g. f64 promotion) still interops —
-            # its delta is representable either way
-            d = np.asarray(p - c, dtype=c.dtype)
-            d *= np.asarray(self.alpha, d.dtype)
-            deltas.append(d)
-        new_leaves = [p - d for p, d in zip(leaves, deltas)]
+        if self._slice is not None:
+            # slice client: params are stacked [L, ...] rows; each row takes
+            # its own elastic pull against the shared center, and the wire
+            # delta is the ROW-SUM over the slice (one in-mesh reduction,
+            # then the single TCP push below) — what L plain clients would
+            # have pushed against the same center snapshot, in 1/L sends
+            row_deltas = []
+            for p, c in zip(leaves, self.center):
+                d = np.asarray(p - c[None], dtype=c.dtype)
+                d *= np.asarray(self.alpha, d.dtype)
+                row_deltas.append(d)
+            new_leaves = [p - d for p, d in zip(leaves, row_deltas)]
+            red, _ = self._slice.all_reduce(row_deltas)
+            deltas = [np.ascontiguousarray(x)
+                      for x in self._slice.node_slice(red, 0)]
+        else:
+            deltas = []
+            for p, c in zip(leaves, self.center):
+                # deltas go over the wire in the CENTER's dtype: the server
+                # rejects dtype skew as config skew, and a client whose
+                # local params drifted wider (e.g. f64 promotion) still
+                # interops — its delta is representable either way
+                d = np.asarray(p - c, dtype=c.dtype)
+                d *= np.asarray(self.alpha, d.dtype)
+                deltas.append(d)
+            new_leaves = [p - d for p, d in zip(leaves, deltas)]
         payloads = None
         if packed:
             if (self.codec != "raw"

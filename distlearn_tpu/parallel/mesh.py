@@ -203,6 +203,25 @@ class MeshTree:
             self._jit_cache[cache_key] = jax.jit(mapped)
         return self._jit_cache[cache_key]
 
+    def all_reduce_program(self, masked: bool = False):
+        """The cached jitted shard_map behind :meth:`all_reduce` — exposed
+        so distlint's ``sync`` family can lower and budget the collective
+        program itself without executing it.  ``masked=True`` returns the
+        contrib-vector variant (``(tree, contrib[num_nodes]) -> (tree,
+        n[num_nodes])``)."""
+        axis = self.axis_name
+        if not masked:
+            def _ar(t):
+                red, _ = all_reduce(squeeze_node(t), axis)
+                return expand_node(red)
+            return self._shard_fn("all_reduce", _ar, 1)
+
+        def _arm(t, c):
+            c = jnp.squeeze(c, 0)
+            red, n = all_reduce(squeeze_node(t), axis, contrib=c)
+            return expand_node(red), n[None]
+        return self._shard_fn("all_reduce_masked", _arm, 2)
+
     def all_reduce(self, tree: PyTree, contrib: jax.Array | None = None
                    ) -> tuple[PyTree, int]:
         """Sum per-node values; every node's row ends up holding the sum.
@@ -212,21 +231,11 @@ class MeshTree:
         the reduced stacked array has identical rows (each node's buffer now
         holds the reduction, like the in-place torch semantics).
         """
-        axis = self.axis_name
-
         if contrib is None:
-            def _ar(t):
-                red, _ = all_reduce(squeeze_node(t), axis)
-                return expand_node(red)
-            out = self._shard_fn("all_reduce", _ar, 1)(tree)
+            out = self.all_reduce_program(False)(tree)
             return out, self.num_nodes
-
-        def _arm(t, c):
-            c = jnp.squeeze(c, 0)
-            red, n = all_reduce(squeeze_node(t), axis, contrib=c)
-            return expand_node(red), n[None]
         contrib = jnp.asarray(contrib)
-        out, n = self._shard_fn("all_reduce_masked", _arm, 2)(tree, contrib)
+        out, n = self.all_reduce_program(True)(tree, contrib)
         return out, int(n[0])
 
     def scatter(self, tree: PyTree, src: int = 0) -> PyTree:

@@ -125,6 +125,8 @@ class DecodeEngine:
                  num_pages: int | None = None):
         import jax
         import jax.numpy as jnp
+        from distlearn_tpu.utils.compile_cache import enable_compile_cache
+        enable_compile_cache()   # warm starts skip the first-tick compile
         self._jax, self._jnp = jax, jnp
         params, self.depth = generate_params(params)
         self.params = params

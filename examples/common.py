@@ -23,6 +23,8 @@ def setup_platform(num_nodes: int, tpu: bool):
     ``num_nodes`` virtual host devices (the reference's LocalhostTree
     analogue, SURVEY.md §4).
     """
+    from distlearn_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()   # DISTLEARN_TPU_COMPILE_CACHE warm starts
     if tpu:
         return
     from distlearn_tpu.utils.platform import force_cpu

@@ -33,7 +33,7 @@ def test_builtin_models_all_clean_and_exhaustive():
     reports = lint_models()
     assert [spec.name for _rep, spec in reports] == [
         "sync", "sharded", "replay", "failover", "serve", "membership",
-        "router"]
+        "router", "backend_sync[host]", "backend_sync[hybrid]"]
     for rep, spec in reports:
         assert rep.findings == [], (
             f"{spec.name}: " + "; ".join(map(str, rep.findings)))
@@ -63,6 +63,19 @@ def test_dl301_sync_without_server_timeouts_deadlocks():
 def test_dl301_sharded_without_server_timeouts_deadlocks():
     rep = check_model(sharded_model(server_timeouts=False))
     assert _rules(rep.findings) == ["DL301"]
+
+
+@pytest.mark.parametrize("backend", ["host", "hybrid"])
+def test_dl301_backend_sync_without_op_timeouts_deadlocks(backend):
+    """Strip the collective's op_timeout arming: a peer process crash
+    mid-round leaves the blocked recv hung forever (SURVEY.md §5, the
+    reference's documented failure mode) — for both the flat TCP tree
+    and the hybrid one-leg-per-host topology."""
+    from distlearn_tpu.lint.model import backend_sync_model
+    rep = check_model(backend_sync_model(backend=backend,
+                                         host_timeouts=False))
+    assert _rules(rep.findings) == ["DL301"]
+    assert "counterexample" in rep.findings[0].message
 
 
 def test_dl303_replay_without_ledger_double_applies():

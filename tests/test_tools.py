@@ -163,10 +163,11 @@ def test_distlint_model_and_races_flags(capsys):
     assert set(doc) == {"findings", "costs", "compiles", "rules", "info",
                         "units", "errors"}
     assert doc["findings"] == [] and doc["errors"] == 0
-    assert doc["units"] == 11
+    assert doc["units"] == 13
     for unit in ("model:sync", "model:sharded", "model:replay",
                  "model:failover", "model:serve", "model:membership",
-                 "model:router"):
+                 "model:router", "model:backend_sync[host]",
+                 "model:backend_sync[hybrid]"):
         assert doc["info"][unit]["states"] > 0
         assert doc["info"][unit]["transitions"] > 0
 

@@ -448,6 +448,52 @@ def raw_speed_table(counter_totals: dict, gauges: dict,
     return tab
 
 
+_SYNC_FAMS = {"sync_rounds_total": "rounds",
+              "sync_host_leg_bytes_total": "host_leg_bytes",
+              "sync_logical_bytes_total": "logical_bytes"}
+_SYNC_SECONDS = "sync_seconds"
+
+
+def _backend_label(key: str, fam: str) -> str | None:
+    prefix = fam + '{backend="'
+    if key.startswith(prefix) and key.endswith('"}'):
+        return key[len(prefix):-2]
+    return None
+
+
+def sync_table(counters: dict, histograms: dict) -> dict:
+    """Derive the per-backend collective-sync table from the sync_*
+    families emitted by :mod:`distlearn_tpu.comm.backend`: rounds run,
+    host-leg (TCP) bytes vs logical (reduced-value) bytes — their ratio
+    is the hierarchical win; for HybridBackend host_leg/round should be
+    ~1/L of HostBackend's at L local devices — and the mean round wall
+    time with the implied syncs/s.  Empty when no backend ever synced."""
+    tab: dict[str, dict] = {}
+
+    def row(backend):
+        return tab.setdefault(backend, {
+            "rounds": 0.0, "host_leg_bytes": 0.0, "logical_bytes": 0.0})
+
+    for key, v in counters.items():
+        for fam, col in _SYNC_FAMS.items():
+            b = _backend_label(key, fam)
+            if b is not None:
+                row(b)[col] += v
+    for key, h in histograms.items():
+        b = _backend_label(key, _SYNC_SECONDS)
+        if b is not None and h["count"]:
+            r = row(b)
+            r["sync_mean"] = h["sum"] / h["count"]
+            r["syncs_per_s"] = (h["count"] / h["sum"] if h["sum"]
+                                else float("inf"))
+    for r in tab.values():
+        r["host_bytes_per_round"] = (r["host_leg_bytes"] / r["rounds"]
+                                     if r["rounds"] else float("nan"))
+        r["host_reduction"] = (r["logical_bytes"] / r["host_leg_bytes"]
+                               if r["host_leg_bytes"] else float("inf"))
+    return dict(sorted(tab.items()))
+
+
 def summarize_run(paths: list[str]) -> dict:
     run = load_run(paths)
     span_tab = {}
@@ -484,7 +530,8 @@ def summarize_run(paths: list[str]) -> dict:
             "raw_speed": raw_speed_table(run["counter_totals"],
                                          run["gauges"],
                                          run["histograms"],
-                                         run["spans"])}
+                                         run["spans"]),
+            "sync": sync_table(run["counters"], run["histograms"])}
 
 
 def diff_runs(a_paths: list[str], b_paths: list[str]) -> dict:
@@ -789,6 +836,20 @@ def _print_summary(doc: dict):
             print(f"{shard:<8} {row['legs']:>8g} "
                   f"{row['wire_bytes']:>14g} {row['applies']:>9g} "
                   f"{_fmt_s(row['apply_mean']):>12}")
+        print()
+    if doc.get("sync"):
+        print(f"{'sync backend':<14} {'rounds':>7} {'host bytes':>13} "
+              f"{'logical bytes':>14} {'host/round':>12} {'reduc':>7} "
+              f"{'mean':>10} {'syncs/s':>9}")
+        for backend, row in doc["sync"].items():
+            sps = row.get("syncs_per_s", float("nan"))
+            print(f"{backend:<14} {row['rounds']:>7g} "
+                  f"{row['host_leg_bytes']:>13g} "
+                  f"{row['logical_bytes']:>14g} "
+                  f"{row['host_bytes_per_round']:>12g} "
+                  f"{row['host_reduction']:>7.1f} "
+                  f"{_fmt_s(row.get('sync_mean', float('nan'))):>10} "
+                  f"{sps:>9.1f}")
         print()
     if doc.get("failover"):
         fo = doc["failover"]
